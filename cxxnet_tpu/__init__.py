@@ -1,0 +1,18 @@
+"""cxxnet_tpu: a TPU-native deep-learning framework with the
+capabilities of cxxnet (reference: /root/reference), built on
+JAX/XLA/Pallas with pjit/shard_map parallelism.
+
+User surface parity: config-file DSL, iterator pipeline, layer zoo,
+updaters + LR schedules, metrics, train/finetune/pred/extract/get_weight
+tasks, snapshot/continue semantics, Python API. See SURVEY.md.
+"""
+
+__version__ = "0.1.0"
+
+from . import graph, layers, updater
+from .graph import NetGraph
+from .utils.config import (parse_config, parse_config_file,
+                           parse_cli_overrides, split_sections)
+
+__all__ = ["NetGraph", "parse_config", "parse_config_file",
+           "parse_cli_overrides", "split_sections", "__version__"]

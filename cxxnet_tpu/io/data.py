@@ -1,0 +1,86 @@
+"""Data pipeline types: DataInst / DataBatch / IIterator.
+
+Mirrors ``/root/reference/src/io/data.h:20-183``: a two-level iterator
+pattern — instance iterators (one example at a time) composed into batch
+iterators by adapters — configured by ordered ``iter = type ... iter =
+end`` blocks with chaining.
+
+TPU-first difference: batches are host NumPy arrays with **static
+shapes**. The reference's dynamic tail batches (AdjustBatchSize,
+neural_net-inl.hpp:287-298) become pad-and-mask: every batch is full
+size and ``num_batch_padd`` marks trailing padding rows that loss,
+metrics, and predictions must ignore (same field as data.h:115).
+
+Batch layout: ``data`` is NHWC (batch, y, x, ch) for spatial inputs or
+(batch, features) for flat inputs — the device layout — while configs
+keep describing shapes as (ch, y, x).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class DataInst:
+    """Single training instance (data.h:42-56)."""
+    index: int
+    data: np.ndarray                  # (y, x, ch) or (features,)
+    label: np.ndarray                 # (label_width,)
+    extra_data: List[np.ndarray] = field(default_factory=list)
+
+
+@dataclass
+class DataBatch:
+    """A batch of instances (data.h:80-150)."""
+    data: np.ndarray                  # (batch, y, x, ch) | (batch, features)
+    label: np.ndarray                 # (batch, label_width)
+    inst_index: Optional[np.ndarray] = None
+    num_batch_padd: int = 0
+    extra_data: List[np.ndarray] = field(default_factory=list)
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+
+class IIterator:
+    """Iterator interface (data.h:20-39): init / before_first / next /
+    value, plus set_param for config plumbing."""
+
+    def set_param(self, name: str, val: str) -> None:
+        pass
+
+    def init(self) -> None:
+        pass
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def next(self) -> bool:
+        raise NotImplementedError
+
+    def value(self):
+        raise NotImplementedError
+
+    # python-iterator convenience
+    def __iter__(self):
+        self.before_first()
+        while self.next():
+            yield self.value()
+
+
+def shape_from_conf(val: str) -> Tuple[int, int, int]:
+    """Parse 'z,y,x' input_shape (ch, y, x)."""
+    z, y, x = (int(t) for t in val.split(","))
+    return (z, y, x)
+
+
+def inst_array_shape(shape3: Tuple[int, int, int]) -> Tuple[int, ...]:
+    ch, y, x = shape3
+    if ch == 1 and y == 1:
+        return (x,)
+    return (y, x, ch)

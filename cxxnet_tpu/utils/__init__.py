@@ -1,0 +1,3 @@
+from . import config, metric
+
+__all__ = ["config", "metric"]

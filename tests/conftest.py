@@ -1,0 +1,31 @@
+"""Test harness: force an 8-device virtual CPU platform so multi-device
+sharding paths run without TPU hardware — the moral equivalent of the
+reference's ps-lite local mode (SURVEY.md §4.5).
+
+Note: this environment preloads jax at interpreter start (site hook), so
+JAX_PLATFORMS in os.environ is read too late; use jax.config instead,
+before any backend is initialized.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np
+import pytest
+
+assert jax.default_backend() == "cpu"
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

@@ -1,0 +1,65 @@
+"""Config grammar + section routing tests, including parsing the actual
+reference example configs (acceptance per SURVEY.md §7 step 1)."""
+
+import os
+
+import pytest
+
+from cxxnet_tpu.utils.config import (ConfigError, parse_config,
+                                     parse_cli_overrides, split_sections)
+
+REF = "/root/reference"
+
+
+def test_basic_pairs():
+    pairs = parse_config("a = 1\nb=2\n  c  =  hello\n")
+    assert pairs == [("a", "1"), ("b", "2"), ("c", "hello")]
+
+
+def test_comments_and_quotes():
+    pairs = parse_config(
+        '# leading comment\npath = "./data/my file" # trailing\nx=3\n')
+    assert pairs == [("path", "./data/my file"), ("x", "3")]
+
+
+def test_bracketed_keys():
+    pairs = parse_config("metric[label] = error\nlayer[0->1] = fullc:fc1\n")
+    assert pairs == [("metric[label]", "error"),
+                     ("layer[0->1]", "fullc:fc1")]
+
+
+def test_missing_value_raises():
+    with pytest.raises(ConfigError):
+        parse_config("a = ")
+    with pytest.raises(ConfigError):
+        parse_config("a b")
+
+
+def test_cli_overrides():
+    assert parse_cli_overrides(["max_round=3", "dev=tpu"]) == \
+        [("max_round", "3"), ("dev", "tpu")]
+
+
+def test_split_sections_mnist():
+    with open(os.path.join(REF, "example/MNIST/MNIST.conf")) as f:
+        pairs = parse_config(f.read())
+    blocks, glob = split_sections(pairs)
+    assert len(blocks) == 2
+    assert blocks[0]["kind"] == "data" and blocks[0]["name"] == "train"
+    assert blocks[1]["kind"] == "eval" and blocks[1]["name"] == "test"
+    assert ("iter", "mnist") in blocks[0]["cfg"]
+    assert ("shuffle", "1") in blocks[0]["cfg"]
+    # netconfig and learning params are global
+    gk = [k for k, _ in glob]
+    assert "netconfig" in gk and "eta" in gk and "batch_size" in gk
+    # iterator params must NOT leak into globals
+    assert "path_img" not in gk
+
+
+def test_split_sections_imagenet():
+    with open(os.path.join(REF, "example/ImageNet/Inception-BN.conf")) as f:
+        pairs = parse_config(f.read())
+    blocks, glob = split_sections(pairs)
+    assert len(blocks) >= 2
+    kinds = [b["kind"] for b in blocks]
+    assert "data" in kinds and "eval" in kinds
