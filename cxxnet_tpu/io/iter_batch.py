@@ -36,7 +36,7 @@ class BatchAdapter(IIterator):
         self.label_width = 1
         self._head: Optional[DataBatch] = None
         self._out: Optional[DataBatch] = None
-        self._epoch_started = False
+        self._epoch_done = False
 
     def set_param(self, name: str, val: str) -> None:
         self.base.set_param(name, val)
@@ -58,7 +58,7 @@ class BatchAdapter(IIterator):
         if self.test_skipread and self._head is not None:
             return                      # keep serving the cached batch
         self.base.before_first()
-        self._epoch_started = False
+        self._epoch_done = False
 
     def _collect(self, n: int) -> List[DataInst]:
         out = []
@@ -82,12 +82,17 @@ class BatchAdapter(IIterator):
         if self.test_skipread and self._head is not None:
             self._out = self._head
             return True
+        if self._epoch_done:
+            return False
         insts = self._collect(self.batch_size)
         if not insts:
             return False
         nreal = len(insts)
         npadd = self.batch_size - nreal     # wrapped/zero rows are padding
         if npadd > 0:
+            # a short collect means the underlying epoch is exhausted;
+            # the (possibly wrapped) batch we emit now is the last one
+            self._epoch_done = True
             if self.round_batch:
                 # wrap around to epoch start (iter_batch_proc:84-108)
                 self.base.before_first()

@@ -1,0 +1,264 @@
+"""CLI task driver.
+
+Parity with ``/root/reference/src/cxxnet_main.cpp:26-575``: a config file
+plus ``key=value`` CLI overrides drives tasks ``train`` / ``finetune`` /
+``pred`` / ``extract_feature`` / ``get_weight``; snapshots are written as
+``<model_dir>/<round:04d>.model.npz``; ``continue=1`` resumes from the
+latest snapshot (SyncLastestModel, :180-202); ``test_io=1`` exercises the
+data pipeline without the net (:455-468); only the root process saves
+and logs in distributed runs (:424-435, 501-503).
+
+Usage: python -m cxxnet_tpu.main config.conf [key=value ...]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .io import create_iterator
+from .nnet.trainer import NetTrainer
+from .parallel import init_distributed, is_root
+from .utils.config import (parse_cli_overrides, parse_config_file,
+                           split_sections)
+
+_MODEL_RE = re.compile(r"^(\d{4})\.model\.npz$")
+
+
+class LearnTask:
+    def __init__(self) -> None:
+        self.task = "train"
+        self.net_type = "feedforward"
+        self.num_round = 10
+        self.start_counter = 1
+        self.save_period = 1
+        self.model_dir = "./models"
+        self.model_in = ""
+        self.continue_training = 0
+        self.print_step = 100
+        self.silent = 0
+        self.task_eval_train = 1
+        self.name_pred = "pred.txt"
+        self.extract_node_name = ""
+        self.weight_filename = "weight.txt"
+        self.weight_layer = ""
+        self.weight_tag = "wmat"
+        self.test_io = 0
+        self.device = ""
+
+    # -- config ----------------------------------------------------------
+
+    def _set(self, name: str, val: str) -> None:
+        if name == "task":
+            self.task = val
+        if name == "net_type":
+            self.net_type = val
+        if name in ("num_round", "max_round"):
+            self.num_round = int(val)
+        if name == "start_counter":
+            self.start_counter = int(val)
+        if name == "save_model":
+            self.save_period = 0 if val == "0" else int(val)
+        if name == "model_dir":
+            self.model_dir = val
+        if name == "model_in":
+            self.model_in = val
+        if name == "continue":
+            self.continue_training = int(val)
+        if name == "print_step":
+            self.print_step = int(val)
+        if name == "silent":
+            self.silent = int(val)
+        if name in ("eval_train", "train_eval"):
+            self.task_eval_train = int(val)
+        if name == "pred":
+            self.name_pred = val
+            self.task = "pred"
+        if name == "extract_node_name":
+            self.extract_node_name = val
+            self.task = "extract_feature"
+        if name == "weight_filename":
+            self.weight_filename = val
+        if name == "weight_layer":
+            self.weight_layer = val
+        if name == "weight_tag":
+            self.weight_tag = val
+        if name == "test_io":
+            self.test_io = int(val)
+        if name == "dev":
+            self.device = val
+
+    # -- model files -----------------------------------------------------
+
+    def _model_path(self, counter: int) -> str:
+        return os.path.join(self.model_dir, "%04d.model.npz" % counter)
+
+    def _sync_latest_model(self) -> Optional[str]:
+        """Find the newest snapshot in model_dir (cxxnet_main:180-202)."""
+        if not os.path.isdir(self.model_dir):
+            return None
+        best = None
+        for fn in os.listdir(self.model_dir):
+            m = _MODEL_RE.match(fn)
+            if m:
+                c = int(m.group(1))
+                if best is None or c > best:
+                    best = c
+        if best is None:
+            return None
+        self.start_counter = best + 1
+        return self._model_path(best)
+
+    # -- run -------------------------------------------------------------
+
+    def run(self, argv: List[str]) -> int:
+        if len(argv) < 1:
+            print("Usage: python -m cxxnet_tpu.main config.conf "
+                  "[key=value ...]")
+            return 1
+        init_distributed()
+        cfg = parse_config_file(argv[0])
+        cfg += parse_cli_overrides(argv[1:])
+        blocks, global_cfg = split_sections(cfg)
+        for name, val in global_cfg:
+            self._set(name, val)
+
+        # model_in via filename convention infers start counter
+        # (cxxnet_main.cpp:204-215)
+        if self.model_in:
+            m = _MODEL_RE.match(os.path.basename(self.model_in))
+            if m:
+                self.start_counter = int(m.group(1)) + 1
+
+        if self.continue_training:
+            latest = self._sync_latest_model()
+            if latest is not None:
+                self.model_in = latest
+
+        # iterators
+        itr_train = None
+        eval_iters: List[Tuple[str, object]] = []
+        pred_iter = None
+        batch_cfg = [(k, v) for k, v in global_cfg
+                     if k in ("batch_size", "input_shape", "label_width")]
+        for b in blocks:
+            it = create_iterator(b["cfg"], batch_cfg)
+            it.init()
+            if b["kind"] == "data":
+                itr_train = it
+            elif b["kind"] == "eval":
+                eval_iters.append((b["name"], it))
+            elif b["kind"] == "pred":
+                pred_iter = it
+
+        if self.test_io:
+            return self._task_test_io(itr_train)
+
+        trainer = NetTrainer(cfg)
+        if self.task in ("train", "finetune"):
+            if self.model_in and self.task == "train":
+                trainer.load_model(self.model_in)
+            else:
+                trainer.init_model()
+                if self.task == "finetune":
+                    assert self.model_in, "finetune requires model_in"
+                    trainer.copy_model_from(self.model_in)
+            return self._task_train(trainer, itr_train, eval_iters)
+
+        assert self.model_in, "task %s requires model_in" % self.task
+        trainer.load_model(self.model_in)
+        if self.task == "pred":
+            return self._task_predict(trainer, pred_iter or itr_train)
+        if self.task == "extract_feature":
+            return self._task_extract(trainer, pred_iter or itr_train)
+        if self.task == "get_weight":
+            return self._task_get_weight(trainer)
+        print("unknown task %r" % self.task)
+        return 1
+
+    def _task_test_io(self, itr) -> int:
+        assert itr is not None, "test_io requires a data block"
+        start = time.time()
+        n = 0
+        for r in range(self.num_round):
+            for batch in itr:
+                n += batch.batch_size - batch.num_batch_padd
+        dt = time.time() - start
+        print("test_io: %d instances in %.2fs (%.1f/sec)"
+              % (n, dt, n / max(dt, 1e-9)))
+        return 0
+
+    def _task_train(self, trainer, itr_train, eval_iters) -> int:
+        assert itr_train is not None, "train requires a data block"
+        start = time.time()
+        for r in range(self.start_counter - 1, self.num_round):
+            trainer.start_round(r)
+            nbatch = 0
+            for batch in itr_train:
+                trainer.update(batch)
+                nbatch += 1
+                if (self.print_step and nbatch % self.print_step == 0
+                        and self.silent == 0 and is_root()):
+                    elapsed = time.time() - start
+                    print("round %8d:[%8d] %ld sec elapsed"
+                          % (r, nbatch, int(elapsed)))
+            line = "[%d]" % (r + 1)
+            if self.task_eval_train:
+                line += trainer.train_metric_str("train")
+            for name, it in eval_iters:
+                line += trainer.evaluate(it, name)
+            if self.silent == 0 and is_root():
+                print(line)
+            if self.save_period and (r + 1) % self.save_period == 0 \
+                    and is_root():
+                os.makedirs(self.model_dir, exist_ok=True)
+                trainer.save_model(self._model_path(r + 1))
+        if self.silent == 0 and is_root():
+            print("updating end, %ld sec in all"
+                  % int(time.time() - start))
+        return 0
+
+    def _task_predict(self, trainer, itr) -> int:
+        assert itr is not None, "pred requires an iterator"
+        with open(self.name_pred, "w") as f:
+            for batch in itr:
+                for v in trainer.predict(batch):
+                    f.write("%g\n" % v)
+        print("finished prediction, write into %s" % self.name_pred)
+        return 0
+
+    def _task_extract(self, trainer, itr) -> int:
+        assert itr is not None, "extract requires an iterator"
+        node = self.extract_node_name
+        with open(self.name_pred, "w") as f:
+            for batch in itr:
+                feats = trainer.extract_feature(batch, node)
+                feats = feats.reshape(feats.shape[0], -1)
+                for row in feats:
+                    f.write(" ".join("%g" % x for x in row) + "\n")
+        print("finished feature extraction, write into %s"
+              % self.name_pred)
+        return 0
+
+    def _task_get_weight(self, trainer) -> int:
+        assert self.weight_layer, "get_weight requires weight_layer"
+        w = trainer.get_weight(self.weight_layer, self.weight_tag)
+        np.savetxt(self.weight_filename, w.reshape(w.shape[0], -1)
+                   if w.ndim > 1 else w[None, :], fmt="%g")
+        print("weight %s:%s %s written to %s"
+              % (self.weight_layer, self.weight_tag, w.shape,
+                 self.weight_filename))
+        return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return LearnTask().run(sys.argv[1:] if argv is None else argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

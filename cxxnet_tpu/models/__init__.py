@@ -1,0 +1,17 @@
+"""Model zoo: programmatic builders for the netconfig DSL.
+
+The framework is config-driven like the reference — a "model" is a
+netconfig text (reference examples: /root/reference/example/MNIST/*.conf,
+example/ImageNet/*.conf, example/kaggle_bowl/bowl.conf). These builders
+generate equivalent architectures (MLP, LeNet-style conv, AlexNet,
+Inception-BN/v1, kaggle-bowl net) for tests, benchmarks, and users who
+prefer Python over config files.
+"""
+
+from .mnist import mnist_mlp, mnist_conv
+from .alexnet import alexnet
+from .inception import inception_bn
+from .bowl import kaggle_bowl
+
+__all__ = ["mnist_mlp", "mnist_conv", "alexnet", "inception_bn",
+           "kaggle_bowl"]

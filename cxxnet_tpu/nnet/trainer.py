@@ -142,7 +142,13 @@ class NetTrainer:
                  for tag, w in pt.items()}
             for lk, pt in self.params.items()}
         if self.mesh is None:
-            self.mesh = make_mesh()
+            # largest data-axis size that divides the global batch (the
+            # reference similarly drops devices that would get an empty
+            # slice, nnet_impl-inl.hpp:378-387)
+            ndev = len(jax.devices())
+            n_data = max(d for d in range(1, ndev + 1)
+                         if self.batch_size % d == 0)
+            self.mesh = make_mesh(n_data, 1)
         # metric bindings -> node indices
         self._metrics = MetricSet()
         self._train_metrics = MetricSet()
@@ -190,8 +196,25 @@ class NetTrainer:
         net = self.net
         metric_nodes = tuple(self._metric_nodes)
         update_period = self.update_period
+        # stable (layer, tag) -> row in the packed hyper array; packing
+        # all per-step host scalars (lr/momentum/wd/epoch/step) into ONE
+        # small array keeps host->device traffic to a single transfer
+        # per step (tunnel/PCIe latency dominates tiny transfers)
+        self._hyper_index = [(lk, tag)
+                             for lk, tags in sorted(self.updaters.items())
+                             for tag in sorted(tags)]
+        self._base_key = jax.random.PRNGKey(self.seed + 1)
 
-        def apply_updates(params, opt_state, grads, hyper):
+        def unpack_hyper(hyper_arr, idx):
+            return {"learning_rate": hyper_arr[idx, 0],
+                    "momentum": hyper_arr[idx, 1],
+                    "wd": hyper_arr[idx, 2],
+                    "epoch": hyper_arr[idx, 3]}
+
+        hyper_row = {(lk, tag): i
+                     for i, (lk, tag) in enumerate(self._hyper_index)}
+
+        def apply_updates(params, opt_state, grads, hyper_arr):
             new_p, new_o = {}, {}
             for lk, ptree in params.items():
                 new_p[lk], new_o[lk] = {}, {}
@@ -200,27 +223,31 @@ class NetTrainer:
                     g = grads[lk][tag]
                     if update_period > 1:
                         g = g / float(update_period)
-                    w2, s2 = upd.apply(w, g, opt_state[lk][tag],
-                                       hyper[lk][tag])
+                    w2, s2 = upd.apply(
+                        w, g, opt_state[lk][tag],
+                        unpack_hyper(hyper_arr, hyper_row[(lk, tag)]))
                     new_p[lk][tag] = w2
                     new_o[lk][tag] = s2
             return new_p, new_o
 
         def train_step(params, opt_state, net_state, grad_acc,
-                       data, labels, mask, hyper, rng, do_update):
+                       data, labels, mask, hyper_arr, base_key,
+                       do_update):
+            step = hyper_arr[0, 4].astype(jnp.uint32)
+            rng = jax.random.fold_in(base_key, step)
             (loss, (new_state, preds)), grads = jax.value_and_grad(
                 net.loss_fn, has_aux=True)(
                     params, net_state, data, labels, mask,
                     rng=rng, collect_nodes=metric_nodes)
             if update_period == 1:
                 params, opt_state = apply_updates(
-                    params, opt_state, grads, hyper)
+                    params, opt_state, grads, hyper_arr)
                 return params, opt_state, new_state, grad_acc, loss, preds
             grad_acc = _tree_add(grad_acc, grads)
 
             def do_apply(args):
                 p, o, acc = args
-                p2, o2 = apply_updates(p, o, acc, hyper)
+                p2, o2 = apply_updates(p, o, acc, hyper_arr)
                 return p2, o2, _tree_zeros_like(acc)
 
             params, opt_state, grad_acc = jax.lax.cond(
@@ -242,20 +269,18 @@ class NetTrainer:
 
     # -- hyper-params per step ------------------------------------------
 
-    def _hyper(self) -> Dict[str, Dict[str, Dict[str, jnp.ndarray]]]:
-        out = {}
+    def _hyper(self) -> np.ndarray:
+        """Packed (n_updaters, 5) array: lr, momentum, wd, epoch, step."""
         epoch = self.update_counter
-        for lk, tags in self.updaters.items():
-            out[lk] = {}
-            for tag, upd in tags.items():
-                upd.param.schedule_epoch(epoch)
-                out[lk][tag] = {
-                    "learning_rate": jnp.float32(upd.param.learning_rate),
-                    "momentum": jnp.float32(upd.param.momentum),
-                    "wd": jnp.float32(upd.param.wd),
-                    "epoch": jnp.float32(epoch),
-                }
-        return out
+        arr = np.zeros((len(self._hyper_index), 5), np.float32)
+        for i, (lk, tag) in enumerate(self._hyper_index):
+            upd = self.updaters[lk][tag]
+            upd.param.schedule_epoch(epoch)
+            arr[i] = (upd.param.learning_rate, upd.param.momentum,
+                      upd.param.wd, epoch, 0.0)
+        arr[0, 4] = self.update_counter * self.update_period \
+            + self.sample_counter
+        return arr
 
     # -- batch plumbing --------------------------------------------------
 
@@ -269,12 +294,15 @@ class NetTrainer:
         return {name: label[:nvalid, a:b]
                 for name, a, b in self._label_slices}
 
+    def _put_batch_array(self, x) -> jnp.ndarray:
+        if isinstance(x, jax.Array) and x.sharding == self._b_shard:
+            return x                      # already resident (test_skipread)
+        return jax.device_put(np.asarray(x, np.float32), self._b_shard)
+
     def _device_batch(self, batch: DataBatch):
-        data = jax.device_put(np.asarray(batch.data, np.float32),
-                              self._b_shard)
-        labels = jax.device_put(np.asarray(batch.label, np.float32),
-                                self._b_shard)
-        mask = jax.device_put(self._mask(batch), self._b_shard)
+        data = self._put_batch_array(batch.data)
+        labels = self._put_batch_array(batch.label)
+        mask = self._put_batch_array(self._mask(batch))
         return data, labels, mask
 
     # -- public API ------------------------------------------------------
@@ -285,16 +313,13 @@ class NetTrainer:
     def update(self, batch: DataBatch) -> None:
         assert self._initialized, "call init_model/load_model first"
         data, labels, mask = self._device_batch(batch)
-        rng = jax.random.fold_in(
-            jax.random.PRNGKey(self.seed + 1),
-            self.update_counter * self.update_period
-            + self.sample_counter)
         hyper = self._hyper()
         self.sample_counter += 1
         do_update = self.sample_counter >= self.update_period
         out = self._train_step(self.params, self.opt_state,
                                self.net_state, self.grad_acc,
-                               data, labels, mask, hyper, rng,
+                               data, labels, mask, hyper,
+                               self._base_key,
                                do_update=bool(do_update))
         (self.params, self.opt_state, self.net_state,
          self.grad_acc, loss, preds) = out

@@ -1,0 +1,87 @@
+"""Model zoo smoke tests: every builder config parses, shape-infers, and
+runs a train step at tiny batch."""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.models import (alexnet, inception_bn, kaggle_bowl,
+                               mnist_conv, mnist_mlp)
+from cxxnet_tpu.nnet.net import FuncNet
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.graph import NetGraph
+from cxxnet_tpu.utils.config import parse_config
+
+
+def _shapes(conf):
+    g = NetGraph()
+    g.configure(parse_config(conf))
+    net = FuncNet(g, g.batch_size)
+    return g, net
+
+
+def test_mnist_mlp_shapes():
+    g, net = _shapes(mnist_mlp())
+    assert net.node_shapes[-1].x == 10
+
+
+def test_mnist_conv_shapes():
+    g, net = _shapes(mnist_conv())
+    # conv 3x3 pad1 stride2 on 28 -> 14; pool 3 stride2 ceil -> 7
+    assert net.node_shapes[1] == (32, 14, 14)
+    assert net.node_shapes[2] == (32, 7, 7)
+    assert net.node_shapes[3].x == 32 * 7 * 7
+
+
+def test_alexnet_shapes():
+    g, net = _shapes(alexnet())
+    # canonical AlexNet shapes (conv1 55, pool1 27, pool2 13, pool5 6)
+    assert net.node_shapes[1] == (96, 55, 55)
+    assert net.node_shapes[3] == (96, 27, 27)
+    assert net.node_shapes[7] == (256, 13, 13)
+    assert net.node_shapes[15] == (256, 6, 6)
+    assert net.node_shapes[-1].x == 1000
+
+
+def test_inception_bn_shapes():
+    g, net = _shapes(inception_bn())
+    # global avg pool collapses to 1x1; softmax over 1000
+    gap = net.node_shapes[g.node_name_map["gap"]]
+    assert (gap.y, gap.x) == (1, 1)
+    assert net.node_shapes[-1].x == 1000
+    assert len(g.layers) > 100
+
+
+def test_kaggle_bowl_shapes():
+    g, net = _shapes(kaggle_bowl())
+    assert net.node_shapes[-1].x == 121
+
+
+@pytest.mark.parametrize("conf_fn,shape,nclass", [
+    (lambda: alexnet(nclass=10, batch_size=4, image_size=67), (4, 67, 67, 3), 10),
+    (lambda: kaggle_bowl(nclass=5, batch_size=4), (4, 40, 40, 3), 5),
+    (lambda: mnist_conv(batch_size=4), (4, 28, 28, 1), 10),
+])
+def test_models_train_step(conf_fn, shape, nclass):
+    t = NetTrainer(parse_config(conf_fn()))
+    t.init_model()
+    rng = np.random.RandomState(0)
+    data = rng.rand(*shape).astype(np.float32)
+    label = rng.randint(0, nclass, (shape[0], 1)).astype(np.float32)
+    t.update(DataBatch(data=data, label=label))
+    assert np.isfinite(t.last_loss)
+
+
+def test_inception_train_step_tiny():
+    conf = inception_bn(nclass=8, batch_size=2, image_size=112)
+    # 112 input -> gap kernel must shrink: rebuild with avg kernel 4
+    conf = conf.replace("  kernel_size = 7", "  kernel_size = 4", 1) \
+        if "kernel_size = 7\n  stride = 1\nlayer[gap" in conf else conf
+    t = NetTrainer(parse_config(inception_bn(nclass=8, batch_size=2,
+                                             image_size=224)))
+    t.init_model()
+    rng = np.random.RandomState(0)
+    data = rng.rand(2, 224, 224, 3).astype(np.float32)
+    label = rng.randint(0, 8, (2, 1)).astype(np.float32)
+    t.update(DataBatch(data=data, label=label))
+    assert np.isfinite(t.last_loss)

@@ -1,0 +1,140 @@
+"""Updater parity tests: closed-form single steps vs the reference
+formulas (sgd/nag/adam_updater-inl.hpp) and schedule/tag-scoping checks."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu.updater import create_updater
+from cxxnet_tpu.updater.param import UpdaterParam
+
+
+def _hyper(upd, epoch=0):
+    upd.param.schedule_epoch(epoch)
+    return {"learning_rate": jnp.float32(upd.param.learning_rate),
+            "momentum": jnp.float32(upd.param.momentum),
+            "wd": jnp.float32(upd.param.wd),
+            "epoch": jnp.float32(epoch)}
+
+
+def test_sgd_step():
+    upd = create_updater("sgd", "wmat",
+                         [("eta", "0.1"), ("momentum", "0.9"),
+                          ("wd", "0.01")])
+    w = jnp.asarray(np.ones(4, np.float32))
+    g = jnp.asarray(np.full(4, 2.0, np.float32))
+    st = upd.init_state(w)
+    w1, st1 = upd.apply(w, g, st, _hyper(upd))
+    # m = 0*0.9 - 0.1*(2 + 0.01*1) = -0.201 ; w = 1 - 0.201
+    np.testing.assert_allclose(np.asarray(w1), 1 - 0.201, rtol=1e-5)
+    w2, _ = upd.apply(w1, g, st1, _hyper(upd, 1))
+    # m2 = -0.201*0.9 - 0.1*(2+0.01*w1)
+    m2 = -0.201 * 0.9 - 0.1 * (2 + 0.01 * (1 - 0.201))
+    np.testing.assert_allclose(np.asarray(w2), (1 - 0.201) + m2, rtol=1e-5)
+
+
+def test_sgd_nan_zeroing_clip():
+    upd = create_updater("sgd", "wmat",
+                         [("eta", "1.0"), ("momentum", "0"),
+                          ("clip_gradient", "0.5")])
+    w = jnp.zeros(3)
+    g = jnp.asarray(np.array([np.nan, 2.0, -2.0], np.float32))
+    w1, _ = upd.apply(w, g, upd.init_state(w), _hyper(upd))
+    # NaN -> 0; ±2 clamped to ±0.5 (sgd_updater-inl.hpp:17-25)
+    np.testing.assert_allclose(np.asarray(w1), [0.0, -0.5, 0.5])
+
+
+def test_nag_step():
+    upd = create_updater("nag", "wmat",
+                         [("eta", "0.1"), ("momentum", "0.9")])
+    w = jnp.asarray(np.ones(2, np.float32))
+    g = jnp.asarray(np.ones(2, np.float32))
+    st = upd.init_state(w)
+    w1, st1 = upd.apply(w, g, st, _hyper(upd))
+    # old=0; m = -0.1; w += 1.9*(-0.1) - 0.9*0 = -0.19
+    np.testing.assert_allclose(np.asarray(w1), 1 - 0.19, rtol=1e-5)
+
+
+def test_adam_step():
+    upd = create_updater("adam", "wmat", [("eta", "0.001")])
+    w = jnp.zeros(2)
+    g = jnp.asarray(np.full(2, 3.0, np.float32))
+    st = upd.init_state(w)
+    w1, st1 = upd.apply(w, g, st, _hyper(upd, 0))
+    d1, d2 = 0.1, 0.001
+    fix1 = 1 - (1 - d1) ** 1
+    fix2 = 1 - (1 - d2) ** 1
+    lr_t = 0.001 * np.sqrt(fix2) / fix1
+    m1 = d1 * 3.0
+    m2 = d2 * 9.0
+    ref = -lr_t * (m1 / (np.sqrt(m2) + 1e-8))
+    np.testing.assert_allclose(np.asarray(w1), ref, rtol=1e-5)
+
+
+def test_lr_schedules():
+    p = UpdaterParam()
+    p.base_lr = 1.0
+    p.lr_minimum = 1e-9
+    # constant
+    p.lr_schedule = 0
+    p.schedule_epoch(10)
+    assert p.learning_rate == 1.0
+    # expdecay: base * gamma^(epoch/step)
+    p.lr_schedule = 1
+    p.lr_gamma = 0.5
+    p.lr_step = 2
+    p.schedule_epoch(4)
+    np.testing.assert_allclose(p.learning_rate, 0.25)
+    # polydecay: base * (1 + (epoch//step)*gamma)^-alpha
+    p.lr_schedule = 2
+    p.lr_gamma = 1.0
+    p.lr_alpha = 1.0
+    p.lr_step = 1
+    p.schedule_epoch(3)
+    np.testing.assert_allclose(p.learning_rate, 0.25)
+    # factor: base * factor^(epoch//step)
+    p.lr_schedule = 3
+    p.lr_factor = 0.1
+    p.lr_step = 5
+    p.schedule_epoch(10)
+    np.testing.assert_allclose(p.learning_rate, 0.01)
+    # minimum clamp
+    p.lr_minimum = 0.05
+    p.schedule_epoch(10)
+    np.testing.assert_allclose(p.learning_rate, 0.05)
+    # start_epoch resets to base
+    p.start_epoch = 100
+    p.schedule_epoch(10)
+    np.testing.assert_allclose(p.learning_rate, 1.0)
+
+
+def test_tag_scoping():
+    # wmat-scoped lr applies to wmat, not bias (updater/param.h:119-125)
+    wupd = create_updater("sgd", "wmat", [("lr", "0.1"),
+                                          ("wmat:lr", "0.5"),
+                                          ("bias:lr", "0.9")])
+    bupd = create_updater("sgd", "bias", [("lr", "0.1"),
+                                          ("wmat:lr", "0.5"),
+                                          ("bias:lr", "0.9")])
+    assert wupd.param.base_lr == 0.5
+    assert bupd.param.base_lr == 0.9
+
+
+def test_layer_cfg_overrides_global():
+    upd = create_updater("sgd", "wmat", [("lr", "0.1")],
+                         [("wmat:lr", "0.01")])
+    assert upd.param.base_lr == 0.01
+
+
+def test_momentum_schedule():
+    p = UpdaterParam()
+    p.momentum_schedule = 1
+    p.saturation_epoch = 10
+    p.base_momentum = 0.5
+    p.final_momentum = 0.9
+    p.schedule_epoch(0)
+    np.testing.assert_allclose(p.momentum, 0.5)
+    p.schedule_epoch(5)
+    np.testing.assert_allclose(p.momentum, 0.7)
+    p.schedule_epoch(100)
+    np.testing.assert_allclose(p.momentum, 0.9)
