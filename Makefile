@@ -1,0 +1,32 @@
+# Native components: RecordIO library (ctypes-loaded by the Python io
+# pipeline) and data packing tools. Parity targets: the reference's
+# Makefile builds libcxxnet wrappers + im2bin/im2rec tools
+# (/root/reference/Makefile:1-160).
+
+CXX ?= g++
+CXXFLAGS = -O3 -fPIC -std=c++17 -Wall
+OPENCV_CFLAGS := $(shell pkg-config --cflags opencv4 2>/dev/null)
+OPENCV_LIBS := $(shell pkg-config --libs opencv4 2>/dev/null)
+
+LIB = lib/libcxxnet_io.so
+TOOLS = bin/im2rec bin/rec2idx
+
+all: $(LIB) $(TOOLS)
+
+lib bin:
+	mkdir -p $@
+
+$(LIB): src/io/recordio.cc src/io/recordio.h | lib
+	$(CXX) $(CXXFLAGS) -shared -o $@ src/io/recordio.cc
+
+bin/im2rec: tools/im2rec.cc src/io/recordio.cc src/io/recordio.h | bin
+	$(CXX) $(CXXFLAGS) $(OPENCV_CFLAGS) -o $@ tools/im2rec.cc \
+		src/io/recordio.cc $(OPENCV_LIBS)
+
+bin/rec2idx: tools/rec2idx.cc src/io/recordio.cc src/io/recordio.h | bin
+	$(CXX) $(CXXFLAGS) -o $@ tools/rec2idx.cc src/io/recordio.cc
+
+clean:
+	rm -rf lib bin
+
+.PHONY: all clean

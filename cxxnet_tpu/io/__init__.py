@@ -20,9 +20,10 @@ from .iter_csv import CSVIterator
 from .iter_mnist import MNISTIterator
 from .iter_mem import MemBufferIterator
 from .iter_img import ImageIterator
+from .iter_imgrec import ImageRecordIterator
 from .iter_augment import AugmentAdapter
 
-_INSTANCE_SOURCES = ("csv", "img")
+_INSTANCE_SOURCES = ("csv", "img", "imgrec")
 
 
 def create_iterator(cfg: Sequence[Tuple[str, str]],
@@ -55,7 +56,14 @@ def create_iterator(cfg: Sequence[Tuple[str, str]],
                 is_instance_level = True
             elif val == "img":
                 assert it is None, "img must be the base iterator"
-                it = ImageIterator()
+                # image sources get the augmenter inline: crop/mirror/
+                # mean/scale params live in the same block, as in the
+                # reference's image iterators
+                it = AugmentAdapter(ImageIterator())
+                is_instance_level = True
+            elif val == "imgrec":
+                assert it is None, "imgrec must be the base iterator"
+                it = AugmentAdapter(ImageRecordIterator())
                 is_instance_level = True
             elif val == "augment":
                 assert it is not None and is_instance_level, \

@@ -1,0 +1,247 @@
+"""RecordIO access: ctypes binding to the native library, with a
+pure-Python implementation of the same (dmlc-compatible) format as
+fallback when the .so isn't built.
+
+See src/io/recordio.{h,cc} for the format; both implementations
+interoperate byte-for-byte (cross-checked in tests/test_recordio.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+KMAGIC = 0xCED7230A
+_MAGIC_BYTES = struct.pack("<I", KMAGIC)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_LIB_PATHS = [
+    os.path.join(_REPO_ROOT, "lib", "libcxxnet_io.so"),
+    os.path.join(os.path.dirname(__file__), "libcxxnet_io.so"),
+]
+
+_lib = None
+for p in _LIB_PATHS:
+    if os.path.exists(p):
+        try:
+            _lib = ctypes.CDLL(p)
+            _lib.CXNRecordIOWriterCreate.restype = ctypes.c_void_p
+            _lib.CXNRecordIOWriterCreate.argtypes = [ctypes.c_char_p]
+            _lib.CXNRecordIOWriterAppend.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+            _lib.CXNRecordIOWriterFree.argtypes = [ctypes.c_void_p]
+            _lib.CXNRecordIOReaderCreate.restype = ctypes.c_void_p
+            _lib.CXNRecordIOReaderCreate.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+            _lib.CXNRecordIOReaderNext.restype = ctypes.c_void_p
+            _lib.CXNRecordIOReaderNext.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+            _lib.CXNRecordIOReaderReset.argtypes = [ctypes.c_void_p]
+            _lib.CXNRecordIOReaderFree.argtypes = [ctypes.c_void_p]
+            break
+        except OSError:
+            _lib = None
+
+
+def native_available() -> bool:
+    return _lib is not None
+
+
+# ------------------------------------------------------------ writers
+
+class _PyWriter:
+    def __init__(self, path: str):
+        self._f = open(path, "wb")
+
+    def write_record(self, data: bytes) -> None:
+        n = len(data)
+        nword = (n + 3) // 4
+        padded = data + b"\x00" * (nword * 4 - n)
+        # split at aligned magic occurrences
+        splits = [i for i in range(nword)
+                  if padded[4 * i:4 * i + 4] == _MAGIC_BYTES]
+        if not splits:
+            self._f.write(_MAGIC_BYTES)
+            self._f.write(struct.pack("<I", n))
+            self._f.write(padded)
+            return
+        begin = 0
+        for k in range(len(splits) + 1):
+            endw = splits[k] if k < len(splits) else nword
+            if k == 0:
+                cflag = 1
+            elif k == len(splits):
+                cflag = 3
+            else:
+                cflag = 2
+            if k == len(splits):
+                tail_bytes = n - begin * 4
+                self._f.write(_MAGIC_BYTES)
+                self._f.write(struct.pack("<I", (cflag << 29) | tail_bytes))
+                nw = (tail_bytes + 3) // 4
+                self._f.write(padded[begin * 4:begin * 4 + nw * 4])
+            else:
+                chunk = padded[begin * 4:endw * 4]
+                self._f.write(_MAGIC_BYTES)
+                self._f.write(struct.pack("<I", (cflag << 29) | len(chunk)))
+                self._f.write(chunk)
+            begin = endw + 1
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class _NativeWriter:
+    def __init__(self, path: str):
+        self._h = _lib.CXNRecordIOWriterCreate(path.encode())
+        if not self._h:
+            raise IOError("cannot create recordio file %r" % path)
+
+    def write_record(self, data: bytes) -> None:
+        _lib.CXNRecordIOWriterAppend(self._h, data, len(data))
+
+    def close(self) -> None:
+        if self._h:
+            _lib.CXNRecordIOWriterFree(self._h)
+            self._h = None
+
+
+def RecordIOWriter(path: str, force_python: bool = False):
+    if _lib is not None and not force_python:
+        return _NativeWriter(path)
+    return _PyWriter(path)
+
+
+# ------------------------------------------------------------ readers
+
+class _PyReader:
+    def __init__(self, path: str, part_index: int = 0,
+                 num_parts: int = 1):
+        self._f = open(path, "rb")
+        self._f.seek(0, 2)
+        fsize = self._f.tell()
+        if num_parts <= 1:
+            self.begin, self.end = 0, fsize
+        else:
+            b = fsize * part_index // num_parts
+            e = fsize * (part_index + 1) // num_parts
+            self.begin = (b + 3) & ~3
+            self.end = min((e + 3) & ~3, fsize)
+        self.reset()
+
+    def reset(self) -> None:
+        self._f.seek(self.begin)
+        self.pos = self.begin
+        if self.begin == 0:
+            return
+        while self.pos + 8 <= self.end:
+            w = self._f.read(4)
+            if len(w) < 4:
+                return
+            self.pos += 4
+            if w == _MAGIC_BYTES:
+                probe = self._f.read(4)
+                if len(probe) < 4:
+                    return
+                flag = struct.unpack("<I", probe)[0] >> 29
+                if flag in (0, 1):
+                    self._f.seek(self.pos - 4)
+                    self.pos -= 4
+                    return
+                self._f.seek(self.pos)
+
+    def next_record(self) -> Optional[bytes]:
+        if self.pos >= self.end:
+            return None
+        out = b""
+        in_multi = False
+        while True:
+            head = self._f.read(8)
+            if len(head) < 8:
+                return None
+            self.pos += 8
+            magic, lrec = struct.unpack("<II", head)
+            if magic != KMAGIC:
+                return None
+            cflag, ln = lrec >> 29, lrec & ((1 << 29) - 1)
+            nword = (ln + 3) // 4
+            chunk = self._f.read(nword * 4)
+            self.pos += nword * 4
+            if in_multi and cflag != 1:
+                out += _MAGIC_BYTES
+            out += chunk[:ln]
+            if cflag in (0, 3):
+                return out
+            in_multi = True
+
+    def __iter__(self) -> Iterator[bytes]:
+        self.reset()
+        while True:
+            r = self.next_record()
+            if r is None:
+                return
+            yield r
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class _NativeReader:
+    def __init__(self, path: str, part_index: int = 0,
+                 num_parts: int = 1):
+        self._h = _lib.CXNRecordIOReaderCreate(path.encode(), part_index,
+                                               num_parts)
+        if not self._h:
+            raise IOError("cannot open recordio file %r" % path)
+
+    def next_record(self) -> Optional[bytes]:
+        size = ctypes.c_uint64()
+        ptr = _lib.CXNRecordIOReaderNext(self._h, ctypes.byref(size))
+        if not ptr or size.value == 0:
+            return None
+        return ctypes.string_at(ptr, size.value)
+
+    def reset(self) -> None:
+        _lib.CXNRecordIOReaderReset(self._h)
+
+    def __iter__(self) -> Iterator[bytes]:
+        self.reset()
+        while True:
+            r = self.next_record()
+            if r is None:
+                return
+            yield r
+
+    def close(self) -> None:
+        if self._h:
+            _lib.CXNRecordIOReaderFree(self._h)
+            self._h = None
+
+
+def RecordIOReader(path: str, part_index: int = 0, num_parts: int = 1,
+                   force_python: bool = False):
+    if _lib is not None and not force_python:
+        return _NativeReader(path, part_index, num_parts)
+    return _PyReader(path, part_index, num_parts)
+
+
+# ------------------------------------------------------- image records
+
+# C layout of ImageRecHeader {uint32 flag; float label; uint64 id[2]}:
+# (flag,label) fill the first 8 bytes, ids start aligned at 8 — 24 bytes
+_HDR = struct.Struct("<IfQQ")
+
+
+def pack_image_record(index: int, label: float, img_bytes: bytes,
+                      flag: int = 0) -> bytes:
+    return _HDR.pack(flag, label, index, 0) + img_bytes
+
+
+def unpack_image_record(rec: bytes) -> Tuple[int, float, bytes]:
+    flag, label, id0, id1 = _HDR.unpack_from(rec, 0)
+    return int(id0), float(label), rec[_HDR.size:]

@@ -76,9 +76,6 @@ class LearnTask:
             self.silent = int(val)
         if name in ("eval_train", "train_eval"):
             self.task_eval_train = int(val)
-        if name == "pred":
-            self.name_pred = val
-            self.task = "pred"
         if name == "extract_node_name":
             self.extract_node_name = val
             self.task = "extract_feature"
@@ -127,10 +124,16 @@ class LearnTask:
         blocks, global_cfg = split_sections(cfg)
         for name, val in global_cfg:
             self._set(name, val)
+        # 'pred = <outfile>' doubles as the pred-block marker
+        # (cxxnet_main.cpp:281-282), so read it from the raw stream
+        for name, val in cfg:
+            if name == "pred":
+                self.name_pred = val
 
-        # model_in via filename convention infers start counter
-        # (cxxnet_main.cpp:204-215)
-        if self.model_in:
+        # model_in via filename convention infers start counter when
+        # continuing training (cxxnet_main.cpp:204-215); finetune starts
+        # a fresh model numbering
+        if self.model_in and self.task == "train":
             m = _MODEL_RE.match(os.path.basename(self.model_in))
             if m:
                 self.start_counter = int(m.group(1)) + 1

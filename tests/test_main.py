@@ -1,0 +1,119 @@
+"""CLI task driver tests: train -> snapshot -> continue -> pred ->
+extract -> get_weight through the real main() with a config file."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.main import main
+from tests.test_trainer import synth_idx
+
+
+def write_conf(tmp_path, pimg, plab, pimg2, plab2, extra=""):
+    conf = """
+data = train
+iter = mnist
+  path_img = "%s"
+  path_label = "%s"
+  shuffle = 1
+  silent = 1
+iter = end
+
+eval = test
+iter = mnist
+  path_img = "%s"
+  path_label = "%s"
+  silent = 1
+iter = end
+
+netconfig=start
+layer[+1:h] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.05
+layer[+1] = relu
+layer[h->o] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,256
+batch_size = 50
+eta = 0.1
+momentum = 0.9
+metric[label] = error
+num_round = 3
+save_model = 1
+model_dir = "%s"
+print_step = 0
+%s
+""" % (pimg, plab, pimg2, plab2, str(tmp_path / "models"), extra)
+    p = str(tmp_path / "run.conf")
+    with open(p, "w") as f:
+        f.write(conf)
+    return p
+
+
+@pytest.fixture
+def setup(tmp_path):
+    pimg, plab = synth_idx(str(tmp_path), n=300, name="tr")
+    pimg2, plab2 = synth_idx(str(tmp_path), n=100, seed=5, name="te")
+    return tmp_path, write_conf(tmp_path, pimg, plab, pimg2, plab2)
+
+
+def test_train_snapshot_continue(setup, capsys):
+    tmp_path, conf = setup
+    assert main([conf]) == 0
+    out = capsys.readouterr().out
+    assert "train-error:" in out and "test-error:" in out
+    mdir = tmp_path / "models"
+    assert sorted(os.listdir(mdir)) == ["0001.model.npz",
+                                        "0002.model.npz",
+                                        "0003.model.npz"]
+    # continue=1 resumes from round 3 and trains rounds 4-5
+    assert main([conf, "continue=1", "num_round=5"]) == 0
+    assert "0005.model.npz" in os.listdir(mdir)
+
+
+def test_pred_extract_get_weight(setup, capsys):
+    tmp_path, conf = setup
+    assert main([conf, "num_round=1"]) == 0
+    model = str(tmp_path / "models" / "0001.model.npz")
+
+    pred_file = str(tmp_path / "pred.txt")
+    assert main([conf, "task=pred", "model_in=" + model,
+                 "pred=" + pred_file]) == 0
+    preds = np.loadtxt(pred_file)
+    assert preds.shape == (300,)          # predicts over the data block
+    assert set(np.unique(preds)) <= {0., 1., 2., 3.}
+
+    feat_file = str(tmp_path / "feat.txt")
+    assert main([conf, "task=extract_feature", "extract_node_name=h",
+                 "model_in=" + model, "pred=" + feat_file]) == 0
+    feats = np.loadtxt(feat_file)
+    assert feats.shape == (300, 32)
+
+    wfile = str(tmp_path / "w.txt")
+    assert main([conf, "task=get_weight", "weight_layer=fc1",
+                 "weight_tag=wmat", "model_in=" + model,
+                 "weight_filename=" + wfile]) == 0
+    w = np.loadtxt(wfile)
+    assert w.shape == (32, 256)
+
+
+def test_finetune_task(setup, capsys):
+    tmp_path, conf = setup
+    assert main([conf, "num_round=1"]) == 0
+    model = str(tmp_path / "models" / "0001.model.npz")
+    mdir2 = str(tmp_path / "models2")
+    assert main([conf, "task=finetune", "model_in=" + model,
+                 "num_round=1", "model_dir=" + mdir2]) == 0
+    assert "0001.model.npz" in os.listdir(mdir2)
+
+
+def test_test_io_mode(setup, capsys):
+    tmp_path, conf = setup
+    assert main([conf, "test_io=1", "num_round=2"]) == 0
+    assert "test_io:" in capsys.readouterr().out

@@ -1,0 +1,172 @@
+"""RecordIO tests: format round-trip, native<->python interop, magic-
+word escaping, sharded reads, im2rec tool, imgrec iterator pipeline."""
+
+import os
+import struct
+import subprocess
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.recordio import (KMAGIC, RecordIOReader,
+                                    RecordIOWriter, native_available,
+                                    pack_image_record,
+                                    unpack_image_record)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _payloads(n=50, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        size = int(rng.randint(1, 2000))
+        out.append(rng.bytes(size))
+    # adversarial payloads containing the magic word at aligned offsets
+    magic = struct.pack("<I", KMAGIC)
+    out.append(magic)
+    out.append(magic * 3)
+    out.append(b"abcd" + magic + b"efgh")
+    out.append(magic + b"xy")
+    out.append(b"12" + magic)          # magic at unaligned offset
+    return out
+
+
+@pytest.mark.parametrize("wpy,rpy", [(True, True), (True, False),
+                                     (False, True), (False, False)])
+def test_roundtrip_interop(tmp_path, wpy, rpy):
+    if (not wpy or not rpy) and not native_available():
+        pytest.skip("native lib not built")
+    path = str(tmp_path / "t.rec")
+    w = RecordIOWriter(path, force_python=wpy)
+    payloads = _payloads()
+    for p in payloads:
+        w.write_record(p)
+    w.close()
+    r = RecordIOReader(path, force_python=rpy)
+    got = list(r)
+    assert len(got) == len(payloads)
+    for a, b in zip(got, payloads):
+        assert a == b
+    r.close()
+
+
+def test_sharded_read_covers_all(tmp_path):
+    path = str(tmp_path / "s.rec")
+    w = RecordIOWriter(path, force_python=True)
+    payloads = _payloads(n=200, seed=3)
+    for p in payloads:
+        w.write_record(p)
+    w.close()
+    for nparts in (2, 3, 5):
+        got = []
+        for pi in range(nparts):
+            r = RecordIOReader(path, pi, nparts, force_python=True)
+            got.extend(list(r))
+            r.close()
+        assert sorted(got) == sorted(payloads), \
+            "shard split lost/duplicated records (nparts=%d)" % nparts
+
+
+@pytest.mark.skipif(not native_available(), reason="native lib not built")
+def test_native_sharded_read(tmp_path):
+    path = str(tmp_path / "ns.rec")
+    w = RecordIOWriter(path, force_python=False)
+    payloads = _payloads(n=100, seed=5)
+    for p in payloads:
+        w.write_record(p)
+    w.close()
+    got = []
+    for pi in range(4):
+        r = RecordIOReader(path, pi, 4, force_python=False)
+        got.extend(list(r))
+        r.close()
+    assert sorted(got) == sorted(payloads)
+
+
+def test_image_record_header():
+    rec = pack_image_record(12345, 7.0, b"JPEGDATA")
+    assert len(rec) == 24 + 8
+    idx, label, payload = unpack_image_record(rec)
+    assert (idx, label, payload) == (12345, 7.0, b"JPEGDATA")
+
+
+def _write_jpegs(tmp_path, n=12, size=32):
+    import cv2
+    rng = np.random.RandomState(0)
+    rows = []
+    d = tmp_path / "imgs"
+    d.mkdir()
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3), np.uint8)
+        fn = "img%03d.jpg" % i
+        cv2.imwrite(str(d / fn), img)
+        rows.append("%d\t%d\t%s" % (i, i % 3, fn))
+    lst = tmp_path / "img.lst"
+    lst.write_text("\n".join(rows) + "\n")
+    return str(lst), str(d)
+
+
+@pytest.mark.skipif(not os.path.exists(os.path.join(REPO, "bin/im2rec")),
+                    reason="im2rec not built")
+def test_im2rec_tool_and_imgrec_iterator(tmp_path):
+    lst, root = _write_jpegs(tmp_path)
+    rec = str(tmp_path / "data.rec")
+    subprocess.check_call([os.path.join(REPO, "bin/im2rec"),
+                           lst, root, rec], stdout=subprocess.DEVNULL)
+    assert os.path.exists(rec)
+
+    from cxxnet_tpu.io import create_iterator
+    cfg = [("iter", "imgrec"), ("path_imgrec", rec), ("silent", "1"),
+           ("input_shape", "3,32,32")]
+    it = create_iterator(cfg, [("batch_size", "4"),
+                               ("input_shape", "3,32,32")])
+    it.init()
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data.shape == (4, 32, 32, 3)
+    labels = sorted(int(l) for b in batches for l in b.label[:, 0])
+    assert labels == sorted([i % 3 for i in range(12)])
+
+
+@pytest.mark.skipif(not os.path.exists(os.path.join(REPO, "bin/im2rec")),
+                    reason="im2rec not built")
+def test_im2rec_resize(tmp_path):
+    lst, root = _write_jpegs(tmp_path, n=4, size=40)
+    rec = str(tmp_path / "r.rec")
+    subprocess.check_call([os.path.join(REPO, "bin/im2rec"),
+                           lst, root, rec, "resize=20"],
+                          stdout=subprocess.DEVNULL)
+    import cv2
+    r = RecordIOReader(rec)
+    rec0 = r.next_record()
+    _, _, payload = unpack_image_record(rec0)
+    img = cv2.imdecode(np.frombuffer(payload, np.uint8),
+                       cv2.IMREAD_COLOR)
+    assert min(img.shape[:2]) == 20
+
+
+def test_imgrec_distributed_parts(tmp_path):
+    """part_index/num_parts shard a single archive without loss."""
+    lst, root = _write_jpegs(tmp_path, n=20)
+    rec = str(tmp_path / "d.rec")
+    w = RecordIOWriter(rec, force_python=True)
+    import cv2
+    for i in range(20):
+        img = (np.ones((8, 8, 3)) * (i * 10 % 255)).astype(np.uint8)
+        ok, enc = cv2.imencode(".png", img)
+        w.write_record(pack_image_record(i, float(i % 4),
+                                         enc.tobytes()))
+    w.close()
+    from cxxnet_tpu.io.iter_imgrec import ImageRecordIterator
+    seen = []
+    for pi in range(3):
+        it = ImageRecordIterator()
+        it.set_param("path_imgrec", rec)
+        it.set_param("part_index", str(pi))
+        it.set_param("num_parts", "3")
+        it.set_param("silent", "1")
+        it.init()
+        while it.next():
+            seen.append(it.value().index)
+    assert sorted(seen) == list(range(20))

@@ -1,0 +1,145 @@
+/*!
+ * \file im2rec.cc
+ * \brief pack images into a RecordIO archive.
+ *
+ * Parity with /root/reference/tools/im2rec.cc:24-139: reads an image
+ * list ("index label path" rows), optionally resizes the short edge and
+ * re-encodes JPEG via OpenCV, writes image records (24-byte header +
+ * jpeg bytes) into <out>.rec; nsplit/part shard the list for parallel
+ * packing.
+ *
+ * Usage: im2rec <image.lst> <image_root> <output.rec>
+ *               [resize=0] [quality=95] [nsplit=1] [part=0]
+ */
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include <opencv2/opencv.hpp>
+
+#include "../src/io/recordio.h"
+
+struct ImageRecHeader {
+  uint32_t flag;
+  float label;
+  uint64_t image_id[2];
+};
+
+int main(int argc, char *argv[]) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "Usage: im2rec image.lst image_root output.rec "
+                 "[resize=0] [quality=95] [nsplit=1] [part=0]\n");
+    return 1;
+  }
+  int resize = 0, quality = 95, nsplit = 1, part = 0;
+  for (int i = 4; i < argc; ++i) {
+    char key[64];
+    int val;
+    if (std::sscanf(argv[i], "%63[^=]=%d", key, &val) == 2) {
+      if (!std::strcmp(key, "resize")) resize = val;
+      if (!std::strcmp(key, "quality")) quality = val;
+      if (!std::strcmp(key, "nsplit")) nsplit = val;
+      if (!std::strcmp(key, "part")) part = val;
+    }
+  }
+  std::ifstream lst(argv[1]);
+  if (!lst.good()) {
+    std::fprintf(stderr, "cannot open %s\n", argv[1]);
+    return 1;
+  }
+  std::string root = argv[2];
+  if (!root.empty() && root.back() != '/') root += '/';
+  std::string outpath = argv[3];
+  if (nsplit > 1) {
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), ".part%d", part);
+    outpath += buf;
+  }
+  cxxnet_tpu::RecordIOWriter writer(outpath.c_str());
+  if (!writer.is_open()) {
+    std::fprintf(stderr, "cannot create %s\n", outpath.c_str());
+    return 1;
+  }
+
+  std::string line;
+  size_t count = 0, lineno = 0;
+  std::string blob;
+  std::vector<uint8_t> encoded;
+  while (std::getline(lst, line)) {
+    size_t myline = lineno++;
+    if (nsplit > 1 &&
+        static_cast<int>(myline % static_cast<size_t>(nsplit)) != part) {
+      continue;
+    }
+    std::istringstream is(line);
+    double index, label;
+    std::string path;
+    if (!(is >> index >> label >> path)) continue;
+    std::string full = root + path;
+
+    ImageRecHeader hdr;
+    std::memset(&hdr, 0, sizeof(hdr));
+    hdr.label = static_cast<float>(label);
+    hdr.image_id[0] = static_cast<uint64_t>(index);
+
+    const uint8_t *payload = nullptr;
+    size_t payload_size = 0;
+    std::vector<uint8_t> filebuf;
+    if (resize == 0) {
+      // pack raw bytes, no decode round-trip
+      FILE *fi = std::fopen(full.c_str(), "rb");
+      if (fi == nullptr) {
+        std::fprintf(stderr, "skip unreadable %s\n", full.c_str());
+        continue;
+      }
+      std::fseek(fi, 0, SEEK_END);
+      long sz = std::ftell(fi);
+      std::fseek(fi, 0, SEEK_SET);
+      filebuf.resize(static_cast<size_t>(sz));
+      if (std::fread(filebuf.data(), 1, filebuf.size(), fi) !=
+          filebuf.size()) {
+        std::fclose(fi);
+        continue;
+      }
+      std::fclose(fi);
+      payload = filebuf.data();
+      payload_size = filebuf.size();
+    } else {
+      cv::Mat img = cv::imread(full, cv::IMREAD_COLOR);
+      if (img.empty()) {
+        std::fprintf(stderr, "skip undecodable %s\n", full.c_str());
+        continue;
+      }
+      // resize short edge (tools/im2rec.cc parity)
+      int h = img.rows, w = img.cols;
+      cv::Mat resized;
+      if (h < w) {
+        cv::resize(img, resized,
+                   cv::Size(w * resize / h, resize));
+      } else {
+        cv::resize(img, resized,
+                   cv::Size(resize, h * resize / w));
+      }
+      std::vector<int> params = {cv::IMWRITE_JPEG_QUALITY, quality};
+      cv::imencode(".jpg", resized, encoded, params);
+      payload = encoded.data();
+      payload_size = encoded.size();
+    }
+    blob.resize(sizeof(hdr) + payload_size);
+    std::memcpy(&blob[0], &hdr, sizeof(hdr));
+    std::memcpy(&blob[sizeof(hdr)], payload, payload_size);
+    writer.WriteRecord(blob.data(), blob.size());
+    if (++count % 1000 == 0) {
+      std::printf("%zu images packed\n", count);
+    }
+  }
+  writer.Close();
+  std::printf("im2rec: packed %zu images into %s\n", count,
+              outpath.c_str());
+  return 0;
+}

@@ -1,0 +1,46 @@
+/*!
+ * \file rec2idx.cc
+ * \brief inspect / index a RecordIO archive: prints one line per record
+ *  (image_id, label, payload bytes) — a debugging companion to im2rec
+ *  (stands in for the reference's bin2rec-era tooling on .rec files).
+ */
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "../src/io/recordio.h"
+
+struct ImageRecHeader {
+  uint32_t flag;
+  float label;
+  uint64_t image_id[2];
+};
+
+int main(int argc, char *argv[]) {
+  if (argc < 2) {
+    std::fprintf(stderr,
+                 "Usage: rec2idx archive.rec [part] [nparts]\n");
+    return 1;
+  }
+  int part = argc > 2 ? std::atoi(argv[2]) : 0;
+  int nparts = argc > 3 ? std::atoi(argv[3]) : 1;
+  cxxnet_tpu::RecordIOReader reader(argv[1], part, nparts);
+  if (!reader.is_open()) {
+    std::fprintf(stderr, "cannot open %s\n", argv[1]);
+    return 1;
+  }
+  std::string rec;
+  size_t n = 0;
+  while (reader.NextRecord(&rec)) {
+    if (rec.size() >= sizeof(ImageRecHeader)) {
+      ImageRecHeader hdr;
+      std::memcpy(&hdr, rec.data(), sizeof(hdr));
+      std::printf("%llu\t%g\t%zu\n",
+                  static_cast<unsigned long long>(hdr.image_id[0]),
+                  hdr.label, rec.size() - sizeof(hdr));
+    }
+    ++n;
+  }
+  std::fprintf(stderr, "%zu records\n", n);
+  return 0;
+}
