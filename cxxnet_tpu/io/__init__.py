@@ -68,7 +68,11 @@ def create_iterator(cfg: Sequence[Tuple[str, str]],
             elif val == "augment":
                 assert it is not None and is_instance_level, \
                     "augment stacks on an instance iterator"
-                it = AugmentAdapter(it)
+                # image sources already carry an inline augmenter; a
+                # second one would apply scale/mean twice (params forward
+                # through to the base), so reuse it
+                if not isinstance(it, AugmentAdapter):
+                    it = AugmentAdapter(it)
             elif val == "batch":
                 assert it is not None and is_instance_level
                 it = BatchAdapter(it)

@@ -153,7 +153,9 @@ class ImageRecordIterator(IIterator):
         if self.shuffle:
             self._rng.shuffle(insts)
         self._buf, self._bufpos = insts, 0
-        return len(insts) > 0
+        # progress was made even if every record in this chunk failed to
+        # decode; next() loops to the following chunk
+        return True
 
     def next(self) -> bool:
         while self._bufpos >= len(self._buf):

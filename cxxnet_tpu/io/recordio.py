@@ -103,7 +103,8 @@ class _NativeWriter:
             raise IOError("cannot create recordio file %r" % path)
 
     def write_record(self, data: bytes) -> None:
-        _lib.CXNRecordIOWriterAppend(self._h, data, len(data))
+        if _lib.CXNRecordIOWriterAppend(self._h, data, len(data)) != 0:
+            raise IOError("recordio write failed (disk full?)")
 
     def close(self) -> None:
         if self._h:
@@ -171,6 +172,8 @@ class _PyReader:
             cflag, ln = lrec >> 29, lrec & ((1 << 29) - 1)
             nword = (ln + 3) // 4
             chunk = self._f.read(nword * 4)
+            if len(chunk) < nword * 4:
+                return None                  # truncated archive
             self.pos += nword * 4
             if in_multi and cflag != 1:
                 out += _MAGIC_BYTES
@@ -202,8 +205,9 @@ class _NativeReader:
     def next_record(self) -> Optional[bytes]:
         size = ctypes.c_uint64()
         ptr = _lib.CXNRecordIOReaderNext(self._h, ctypes.byref(size))
-        if not ptr or size.value == 0:
+        if not ptr:
             return None
+        # size 0 is a legitimate empty record, not EOF (EOF is NULL)
         return ctypes.string_at(ptr, size.value)
 
     def reset(self) -> None:

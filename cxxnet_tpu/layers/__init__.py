@@ -7,9 +7,11 @@ reference registers but cannot construct (``maxout``, ``softplus`` maps
 via the enum but has no factory case — configuring them errors, matching
 ``layer_impl-inl.hpp``; we support softplus since our factory covers it).
 
-``pairtest-A-B`` from the reference is realized as a test fixture
-(``tests/test_layers.py``) instead of a layer type: the slave
-implementation is a NumPy reference model.
+``pairtest-A-B`` is a real layer type (layer.h:316-317,358-362 encodes
+master*1024+slave; we parse the string directly): master and slave run
+side by side, divergence is tracked in layer state — see pairtest.py.
+The NumPy-reference comparisons in ``tests/test_layers.py`` complement
+it for gradient checks.
 """
 
 from __future__ import annotations
@@ -23,6 +25,7 @@ from .common import (ActivationLayer, BiasLayer, ConcatLayer, DropoutLayer,
 from .conv import (BatchNormLayer, ConvolutionLayer, InsanityPoolingLayer,
                    LRNLayer, PoolingLayer)
 from .loss import LossLayer, LpLossLayer, MultiLogisticLayer, SoftmaxLayer
+from .pairtest import PairTestLayer
 
 _FACTORY: Dict[str, Callable[..., Layer]] = {
     "fullc": lambda cfg, **kw: FullConnectLayer(cfg),
@@ -62,12 +65,29 @@ _VESTIGIAL = ("maxout",)
 
 
 def known_layer_type(type_str: str) -> bool:
+    if type_str.startswith("pairtest-"):
+        a, _, b = type_str[len("pairtest-"):].partition("-")
+        return known_layer_type(a) and known_layer_type(b)
     return type_str in _FACTORY or type_str in _VESTIGIAL
 
 
 def create_layer(type_str: str, cfg: Sequence[Tuple[str, str]] = (),
                  **kwargs) -> Layer:
     """Create a layer from its config-file type string."""
+    if type_str.startswith("pairtest-"):
+        a, _, b = type_str[len("pairtest-"):].partition("-")
+        if not a or not b:
+            raise ValueError("pairtest type must be pairtest-<master>-<slave>")
+        cfg = list(cfg)
+        shared = [(n, v) for n, v in cfg
+                  if not n.startswith(("master:", "slave:"))]
+        master = create_layer(a, shared + [
+            (n[len("master:"):], v) for n, v in cfg
+            if n.startswith("master:")], **kwargs)
+        slave = create_layer(b, shared + [
+            (n[len("slave:"):], v) for n, v in cfg
+            if n.startswith("slave:")], **kwargs)
+        return PairTestLayer(master, slave)
     if type_str in _VESTIGIAL:
         raise ValueError(
             "layer type %r is registered but has no implementation "

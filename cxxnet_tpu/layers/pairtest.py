@@ -1,0 +1,126 @@
+"""Pairwise layer testing: ``pairtest-<master>-<slave>``.
+
+The reference's built-in layer correctness harness
+(``/root/reference/src/layer/pairtest_layer-inl.hpp:15-203``): one
+connection runs a *master* and a *slave* implementation of the same
+layer on identical inputs and compares their outputs every Forward.
+
+Functional re-design: the master's output is what flows on; the slave is
+tied in with ``m + s - stop_gradient(s)`` so its value cancels exactly
+while autodiff routes the *same* output-gradient to both — the
+equivalent of the reference feeding both implementations the same
+out-node gradient in Backprop. Both sides are initialized from the same
+PRNG key and receive the same per-step RNG, so after identical updates
+their weights must track each other; the running forward divergence is
+recorded in layer state under ``pairtest:max_diff`` (the reference
+printed/asserted it inline).
+
+Config routing matches the reference's prefix passthrough
+(``master:xxx`` / ``slave:xxx``; everything else goes to both).
+
+This is how Pallas kernels are validated against their XLA reference
+formulation (the reference used it for hand CUDA vs cuDNN vs Caffe).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import Layer, Shape3
+
+
+class PairTestLayer(Layer):
+    """Runs master + slave implementations side by side."""
+
+    def __init__(self, master: Layer, slave: Layer,
+                 cfg: Sequence[Tuple[str, str]] = ()) -> None:
+        self.master = master
+        self.slave = slave
+        super().__init__(cfg)
+        # mirror loss-ness of the wrapped layer so the net treats a
+        # pairtested loss layer correctly
+        self.is_loss = master.is_loss
+        self.self_loop = master.self_loop
+
+    def set_param(self, name: str, val: str) -> None:
+        if name.startswith("master:"):
+            self.master.set_param(name[len("master:"):], val)
+        elif name.startswith("slave:"):
+            self.slave.set_param(name[len("slave:"):], val)
+        else:
+            self.master.set_param(name, val)
+            self.slave.set_param(name, val)
+
+    def infer_shape(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        mo = self.master.infer_shape(list(in_shapes))
+        so = self.slave.infer_shape(list(in_shapes))
+        if mo != so:
+            raise ValueError(
+                "pairtest: master/slave output shapes disagree: %s vs %s"
+                % (mo, so))
+        self.in_shapes = list(in_shapes)
+        self.out_shapes = mo
+        return mo
+
+    def init_params(self, key: jax.Array) -> Dict[str, jnp.ndarray]:
+        # same key on both sides -> identical initial weights whenever
+        # the two implementations use the same parameter shapes
+        p = dict(self.master.init_params(key))
+        for tag, v in self.slave.init_params(key).items():
+            p["slave:" + tag] = v
+        return p
+
+    def init_state(self) -> Dict[str, jnp.ndarray]:
+        s = dict(self.master.init_state())
+        for tag, v in self.slave.init_state().items():
+            s["slave:" + tag] = v
+        s["pairtest:max_diff"] = jnp.float32(0.0)
+        return s
+
+    def _split(self, d: Dict[str, jnp.ndarray]):
+        m = {k: v for k, v in d.items()
+             if not k.startswith(("slave:", "pairtest:"))}
+        s = {k[len("slave:"):]: v for k, v in d.items()
+             if k.startswith("slave:")}
+        return m, s
+
+    def forward(self, params, state, inputs, is_train, rng):
+        mp, sp = self._split(params)
+        ms, ss = self._split(state)
+        mouts, ms2 = self.master.forward(mp, ms, list(inputs),
+                                         is_train, rng)
+        souts, ss2 = self.slave.forward(sp, ss, list(inputs),
+                                        is_train, rng)
+        diff = jnp.float32(0.0)
+        outs = []
+        for m, s in zip(mouts, souts):
+            diff = jnp.maximum(diff, jnp.max(jnp.abs(m - s)))
+            # value == m exactly; gradient flows identically to both
+            outs.append(m + s - jax.lax.stop_gradient(s))
+        new_state = dict(ms2 or ms)
+        for tag, v in (ss2 or ss).items():
+            new_state["slave:" + tag] = v
+        new_state["pairtest:max_diff"] = jnp.maximum(
+            state.get("pairtest:max_diff", jnp.float32(0.0)), diff)
+        return outs, new_state
+
+    # loss-layer protocol passthrough (when pairtesting a loss layer)
+
+    @property
+    def target(self):
+        return self.master.target
+
+    @property
+    def batch_size(self):
+        return self.master.batch_size
+
+    @batch_size.setter
+    def batch_size(self, v):
+        self.master.batch_size = v
+        self.slave.batch_size = v
+
+    def loss_value(self, logit, labels, mask):
+        return self.master.loss_value(logit, labels, mask)

@@ -23,7 +23,6 @@ import numpy as np
 
 from ..graph import NetGraph
 from ..layers import Layer, Shape3, create_layer
-from ..layers.loss import LossLayer
 
 Params = Dict[str, Dict[str, jnp.ndarray]]
 NetState = Dict[str, Dict[str, jnp.ndarray]]
@@ -58,7 +57,7 @@ class FuncNet:
                 if g.effective_type(li) == "split":
                     kwargs["n_out"] = len(info.nindex_out)
                 layer = create_layer(info.type, cfg, **kwargs)
-                if isinstance(layer, LossLayer) and layer.batch_size == 0:
+                if layer.is_loss and layer.batch_size == 0:
                     layer.batch_size = self.batch_size
             self.layer_objs.append(layer)
             # shape inference for this connection
@@ -133,7 +132,7 @@ class FuncNet:
             ins = [nodes[ni] for ni in info.nindex_in]
             lrng = (jax.random.fold_in(rng, li)
                     if rng is not None else None)
-            if collect_logits and isinstance(layer, LossLayer):
+            if collect_logits and layer.is_loss:
                 loss_inputs[li] = ins[0]
             outs, s2 = layer.forward(p, s, ins, is_train, lrng)
             if s2:
@@ -165,7 +164,7 @@ class FuncNet:
         total = jnp.float32(0.0)
         for li, logit in loss_inputs.items():
             layer = self.layer_objs[li]
-            assert isinstance(layer, LossLayer)
+            assert layer.is_loss
             if layer.target not in slices:
                 raise ValueError("loss layer: unknown target=%s"
                                  % layer.target)
@@ -178,7 +177,7 @@ class FuncNet:
 
     def loss_layer_indices(self) -> List[int]:
         return [li for li, l in enumerate(self.layer_objs)
-                if isinstance(l, LossLayer)]
+                if l.is_loss]
 
     def node_index_by_name(self, name: str) -> int:
         g = self.graph

@@ -45,7 +45,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..graph import NetGraph
 from ..io.data import DataBatch
 from ..layers import as_mat
-from ..layers.loss import LossLayer
 from ..parallel import (batch_sharding, make_mesh, param_sharding,
                         replicated)
 from ..updater import create_updater

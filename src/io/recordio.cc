@@ -29,9 +29,13 @@ RecordIOWriter::~RecordIOWriter() { Close(); }
 
 void RecordIOWriter::Close() {
   if (fp_ != nullptr) {
-    std::fclose(fp_);
+    if (std::fclose(fp_) != 0) fail_ = true;
     fp_ = nullptr;
   }
+}
+
+void RecordIOWriter::Put(const void *data, size_t nmemb) {
+  if (std::fwrite(data, 4, nmemb, fp_) != nmemb) fail_ = true;
 }
 
 void RecordIOWriter::WriteChunk(const uint32_t *data, size_t nword,
@@ -39,9 +43,9 @@ void RecordIOWriter::WriteChunk(const uint32_t *data, size_t nword,
   uint32_t magic = kRecordMagic;
   uint32_t lrec = EncodeLRec(cflag,
                              static_cast<uint32_t>(nword * 4U));
-  std::fwrite(&magic, 4, 1, fp_);
-  std::fwrite(&lrec, 4, 1, fp_);
-  if (nword != 0) std::fwrite(data, 4, nword, fp_);
+  Put(&magic, 1);
+  Put(&lrec, 1);
+  if (nword != 0) Put(data, nword);
 }
 
 void RecordIOWriter::WriteRecord(const void *buf, size_t size) {
@@ -60,10 +64,10 @@ void RecordIOWriter::WriteRecord(const void *buf, size_t size) {
     // single whole record: write true byte length
     uint32_t magic = kRecordMagic;
     uint32_t lrec = EncodeLRec(0U, static_cast<uint32_t>(size));
-    std::fwrite(&magic, 4, 1, fp_);
-    std::fwrite(&lrec, 4, 1, fp_);
+    Put(&magic, 1);
+    Put(&lrec, 1);
     size_t n = (size + 3U) >> 2U;
-    if (n != 0) std::fwrite(words.data(), 4, n, fp_);
+    if (n != 0) Put(words.data(), n);
     return;
   }
   // multi-chunk: payload between magic words; readers re-insert magic
@@ -80,10 +84,10 @@ void RecordIOWriter::WriteRecord(const void *buf, size_t size) {
       uint32_t magic = kRecordMagic;
       uint32_t lrec = EncodeLRec(cflag,
                                  static_cast<uint32_t>(tail_bytes));
-      std::fwrite(&magic, 4, 1, fp_);
-      std::fwrite(&lrec, 4, 1, fp_);
+      Put(&magic, 1);
+      Put(&lrec, 1);
       size_t n = (tail_bytes + 3U) >> 2U;
-      if (n != 0) std::fwrite(words.data() + begin, 4, n, fp_);
+      if (n != 0) Put(words.data() + begin, n);
     } else {
       WriteChunk(words.data() + begin, endw - begin, cflag);
     }
@@ -201,9 +205,9 @@ void *CXNRecordIOWriterCreate(const char *path) {
 
 int CXNRecordIOWriterAppend(void *handle, const char *data,
                             uint64_t size) {
-  static_cast<cxxnet_tpu::RecordIOWriter *>(handle)->WriteRecord(
-      data, static_cast<size_t>(size));
-  return 0;
+  auto *w = static_cast<cxxnet_tpu::RecordIOWriter *>(handle);
+  w->WriteRecord(data, static_cast<size_t>(size));
+  return w->HasError() ? -1 : 0;
 }
 
 void CXNRecordIOWriterFree(void *handle) {

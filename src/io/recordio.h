@@ -39,10 +39,14 @@ class RecordIOWriter {
   bool is_open() const { return fp_ != nullptr; }
   void WriteRecord(const void *buf, size_t size);
   void Close();
+  /*! \brief true after any short write (e.g. disk full) */
+  bool HasError() const { return fail_; }
 
  private:
   void WriteChunk(const uint32_t *data, size_t nword, uint32_t cflag);
+  void Put(const void *data, size_t nmemb);
   FILE *fp_;
+  bool fail_ = false;
 };
 
 class RecordIOReader {
@@ -72,6 +76,7 @@ class RecordIOReader {
 extern "C" {
 /* C ABI for ctypes */
 void *CXNRecordIOWriterCreate(const char *path);
+/* returns 0 on success, -1 after a failed write (disk full etc.) */
 int CXNRecordIOWriterAppend(void *handle, const char *data,
                             uint64_t size);
 void CXNRecordIOWriterFree(void *handle);

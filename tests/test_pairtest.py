@@ -1,0 +1,116 @@
+"""The pairtest-<master>-<slave> layer (reference pairtest_layer-inl.hpp).
+
+Checks: identical implementations diverge by 0; the master's value flows
+on unchanged; both sides receive the same output-gradient (the
+reference's Backprop comparison); config prefix routing; end-to-end use
+inside a configured net via the trainer.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cxxnet_tpu.layers import Shape3, create_layer
+
+
+def _setup(ltype, cfg, in_shape, x):
+    layer = create_layer(ltype, cfg)
+    layer.infer_shape([Shape3(*in_shape)])
+    params = layer.init_params(jax.random.PRNGKey(3))
+    state = layer.init_state()
+    return layer, params, state
+
+
+def test_pairtest_identical_impls_zero_diff(rng):
+    x = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+    layer, params, state = _setup(
+        "pairtest-fullc-fullc", [("nhidden", "6")], (1, 1, 8), x)
+    outs, new_state = layer.forward(params, state, [x], False, None)
+    assert float(new_state["pairtest:max_diff"]) == 0.0
+    # value equals the master alone
+    mouts, _ = layer.master.forward(
+        {k: v for k, v in params.items() if not k.startswith("slave:")},
+        {}, [x], False, None)
+    np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(mouts[0]),
+                               rtol=1e-6)
+
+
+def test_pairtest_gradient_flows_to_both(rng):
+    x = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+    layer, params, state = _setup(
+        "pairtest-fullc-fullc", [("nhidden", "6")], (1, 1, 8), x)
+
+    def f(p):
+        outs, _ = layer.forward(p, state, [x], True, None)
+        return jnp.sum(outs[0] ** 2)
+
+    g = jax.grad(f)(params)
+    # identical impls + same init -> identical gradients on both sides
+    np.testing.assert_allclose(np.asarray(g["wmat"]),
+                               np.asarray(g["slave:wmat"]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g["bias"]),
+                               np.asarray(g["slave:bias"]), rtol=1e-5)
+    assert np.abs(np.asarray(g["wmat"])).sum() > 0
+
+
+def test_pairtest_detects_divergence(rng):
+    x = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+    # relu vs tanh genuinely differ
+    layer, params, state = _setup(
+        "pairtest-relu-tanh", [], (1, 1, 8), x)
+    _, new_state = layer.forward(params, state, [x], False, None)
+    assert float(new_state["pairtest:max_diff"]) > 1e-3
+
+
+def test_pairtest_prefix_routing():
+    layer = create_layer("pairtest-fullc-fullc",
+                         [("nhidden", "6"),
+                          ("master:init_sigma", "0.5"),
+                          ("slave:init_sigma", "0.1")])
+    assert layer.master.param.num_hidden == 6
+    assert layer.slave.param.num_hidden == 6
+    assert layer.master.param.init_sigma == 0.5
+    assert layer.slave.param.init_sigma == 0.1
+
+
+def test_pairtest_shape_mismatch_rejected():
+    layer = create_layer("pairtest-fullc-fullc",
+                         [("master:nhidden", "6"), ("slave:nhidden", "7")])
+    try:
+        layer.infer_shape([Shape3(1, 1, 8)])
+    except ValueError as e:
+        assert "disagree" in str(e)
+    else:
+        raise AssertionError("shape mismatch not detected")
+
+
+def test_pairtest_in_net_trainer(rng):
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+
+    conf = [
+        ("input_shape", "1,1,10"),
+        ("batch_size", "8"),
+        ("netconfig", "start"),
+        ("layer[0->1]", "pairtest-fullc-fullc:fc1"),
+        ("nhidden", "16"),
+        ("layer[1->2]", "relu"),
+        ("layer[2->3]", "fullc:fc2"),
+        ("nhidden", "4"),
+        ("layer[3->3]", "softmax"),
+        ("netconfig", "end"),
+        ("eta", "0.1"),
+    ]
+    t = NetTrainer(conf)
+    t.init_model()
+    data = rng.rand(8, 10).astype(np.float32)
+    label = rng.randint(0, 4, (8, 1)).astype(np.float32)
+    for _ in range(3):
+        t.update(DataBatch(data=data, label=label))
+    assert np.isfinite(t.last_loss)
+    # identical master/slave stay in lockstep through training
+    diff = float(np.asarray(t.net_state["fc1"]["pairtest:max_diff"]))
+    assert diff < 1e-4, "pairtest divergence %g" % diff
+    w = np.asarray(t.params["fc1"]["wmat"])
+    ws = np.asarray(t.params["fc1"]["slave:wmat"])
+    np.testing.assert_allclose(w, ws, atol=1e-5)

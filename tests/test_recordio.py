@@ -16,6 +16,22 @@ from cxxnet_tpu.io.recordio import (KMAGIC, RecordIOReader,
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _ensure_built() -> bool:
+    """Build the native lib/tools on demand (they are gitignored)."""
+    if os.path.exists(os.path.join(REPO, "bin/im2rec")):
+        return True
+    try:
+        subprocess.check_call(["make", "-s", "-C", REPO],
+                              stdout=subprocess.DEVNULL,
+                              stderr=subprocess.DEVNULL)
+    except (OSError, subprocess.CalledProcessError):
+        return False
+    return os.path.exists(os.path.join(REPO, "bin/im2rec"))
+
+
+_HAVE_TOOLS = _ensure_built()
+
+
 def _payloads(n=50, seed=0):
     rng = np.random.RandomState(seed)
     out = []
@@ -29,6 +45,8 @@ def _payloads(n=50, seed=0):
     out.append(b"abcd" + magic + b"efgh")
     out.append(magic + b"xy")
     out.append(b"12" + magic)          # magic at unaligned offset
+    out.append(b"")                    # empty record is valid, not EOF
+    out.append(b"after-empty")         # records after it must survive
     return out
 
 
@@ -107,8 +125,7 @@ def _write_jpegs(tmp_path, n=12, size=32):
     return str(lst), str(d)
 
 
-@pytest.mark.skipif(not os.path.exists(os.path.join(REPO, "bin/im2rec")),
-                    reason="im2rec not built")
+@pytest.mark.skipif(not _HAVE_TOOLS, reason="im2rec not built")
 def test_im2rec_tool_and_imgrec_iterator(tmp_path):
     lst, root = _write_jpegs(tmp_path)
     rec = str(tmp_path / "data.rec")
@@ -129,8 +146,7 @@ def test_im2rec_tool_and_imgrec_iterator(tmp_path):
     assert labels == sorted([i % 3 for i in range(12)])
 
 
-@pytest.mark.skipif(not os.path.exists(os.path.join(REPO, "bin/im2rec")),
-                    reason="im2rec not built")
+@pytest.mark.skipif(not _HAVE_TOOLS, reason="im2rec not built")
 def test_im2rec_resize(tmp_path):
     lst, root = _write_jpegs(tmp_path, n=4, size=40)
     rec = str(tmp_path / "r.rec")

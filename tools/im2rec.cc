@@ -139,6 +139,11 @@ int main(int argc, char *argv[]) {
     }
   }
   writer.Close();
+  if (writer.HasError()) {
+    std::fprintf(stderr, "im2rec: write failed (disk full?): %s\n",
+                 outpath.c_str());
+    return 1;
+  }
   std::printf("im2rec: packed %zu images into %s\n", count,
               outpath.c_str());
   return 0;
