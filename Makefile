@@ -8,16 +8,31 @@ CXXFLAGS = -O3 -fPIC -std=c++17 -Wall
 OPENCV_CFLAGS := $(shell pkg-config --cflags opencv4 2>/dev/null)
 OPENCV_LIBS := $(shell pkg-config --libs opencv4 2>/dev/null)
 
+PY_CFLAGS := $(shell python3-config --includes 2>/dev/null)
+PY_LIBDIR := $(shell python3 -c "import sysconfig; print(sysconfig.get_config_var('LIBDIR'))" 2>/dev/null)
+PY_VER := $(shell python3 -c "import sysconfig; print(sysconfig.get_config_var('LDVERSION'))" 2>/dev/null)
+
 LIB = lib/libcxxnet_io.so
+WRAPLIB = lib/libcxxnet_wrapper.so
 TOOLS = bin/im2rec bin/rec2idx
 
+# the Python-embedding wrapper needs python3 dev headers; skip when absent
+ifneq ($(PY_CFLAGS),)
+all: $(LIB) $(TOOLS) $(WRAPLIB)
+else
 all: $(LIB) $(TOOLS)
+endif
 
 lib bin:
 	mkdir -p $@
 
 $(LIB): src/io/recordio.cc src/io/recordio.h | lib
 	$(CXX) $(CXXFLAGS) -shared -o $@ src/io/recordio.cc
+
+$(WRAPLIB): wrapper/cxxnet_wrapper.cc wrapper/cxxnet_wrapper.h | lib
+	$(CXX) $(CXXFLAGS) $(PY_CFLAGS) -shared -o $@ \
+		wrapper/cxxnet_wrapper.cc \
+		-L$(PY_LIBDIR) -Wl,-rpath,$(PY_LIBDIR) -lpython$(PY_VER) -ldl
 
 bin/im2rec: tools/im2rec.cc src/io/recordio.cc src/io/recordio.h | bin
 	$(CXX) $(CXXFLAGS) $(OPENCV_CFLAGS) -o $@ tools/im2rec.cc \

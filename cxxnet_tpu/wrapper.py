@@ -1,0 +1,286 @@
+"""User-facing Python API: ``DataIter``, ``Net``, ``train``.
+
+Same surface as the reference's Python wrapper
+(``/root/reference/wrapper/cxxnet.py:65-308``), which wrapped the C ABI
+with ctypes. Here the framework *is* Python, so these classes sit
+directly on the core; the C ABI (``wrapper/cxxnet_wrapper.cc``) embeds
+the interpreter and dispatches to this same module, keeping one backend
+for Python, C, and Matlab callers.
+
+Layout convention at this boundary is the reference's: 4-D batches are
+``(batch, channel, height, width)`` (NCHW) numpy float32; labels are
+``(batch, label_width)``. Internally the framework stores spatial nodes
+NHWC for the MXU — conversion happens here, once, at the API edge.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .io import create_iterator
+from .io.data import DataBatch
+from .nnet.trainer import NetTrainer
+from .utils.config import parse_config, split_sections
+
+
+def _nchw_to_internal(data: np.ndarray, is_mat: bool) -> np.ndarray:
+    """(b,c,h,w) user array -> internal NHWC / (b,features) layout."""
+    data = np.asarray(data, np.float32)
+    if data.ndim != 4:
+        raise ValueError(
+            "need a 4 dimensional tensor (batch, channel, height, width)")
+    if is_mat:
+        b, c, h, w = data.shape
+        if c == 1 and h == 1:
+            return data.reshape(b, w)
+        return data.reshape(b, -1)
+    return np.transpose(data, (0, 2, 3, 1))
+
+
+def _internal_to_nchw(data: np.ndarray) -> np.ndarray:
+    """internal NHWC / (b,features) -> (b,c,h,w) user array."""
+    data = np.asarray(data)
+    if data.ndim == 2:
+        return data.reshape(data.shape[0], 1, 1, data.shape[1])
+    return np.transpose(data, (0, 3, 1, 2))
+
+
+class DataIter:
+    """Data iterator (reference cxxnet.py:65-103).
+
+    ``cfg`` is config text containing one iterator block, e.g.::
+
+        iter = mnist
+        path_img = ...
+        iter = end
+
+    plus any batch params (batch_size, input_shape, label_width).
+    """
+
+    def __init__(self, cfg: str):
+        pairs = parse_config(cfg)
+        blocks, global_cfg = split_sections(pairs)
+        if not blocks:
+            raise ValueError("DataIter config contains no iterator block")
+        if len(blocks) > 1:
+            raise ValueError("DataIter config must contain exactly one "
+                             "iterator block")
+        batch_cfg = [(k, v) for k, v in global_cfg
+                     if k in ("batch_size", "input_shape", "label_width")]
+        self._it = create_iterator(blocks[0]["cfg"], batch_cfg)
+        self._it.init()
+        self.head = True
+        self.tail = False
+
+    def next(self) -> bool:
+        ok = self._it.next()
+        self.head = False
+        self.tail = not ok
+        return ok
+
+    def before_first(self) -> None:
+        self._it.before_first()
+        self.head = True
+        self.tail = False
+
+    def check_valid(self) -> None:
+        if self.head:
+            raise RuntimeError(
+                "iterator was at head state, call next to get to valid "
+                "state")
+        if self.tail:
+            raise RuntimeError("iterator reaches end")
+
+    @property
+    def batch(self) -> DataBatch:
+        self.check_valid()
+        return self._it.value()
+
+    def get_data(self) -> np.ndarray:
+        """Current batch data in (batch, channel, height, width)."""
+        return _internal_to_nchw(self.batch.data)
+
+    def get_label(self) -> np.ndarray:
+        """Current batch label (batch, label_width)."""
+        lab = np.asarray(self.batch.label, np.float32)
+        if lab.ndim == 1:
+            lab = lab.reshape(-1, 1)
+        return lab
+
+    def __iter__(self):
+        self.before_first()
+        while self.next():
+            yield self.batch
+
+
+class Net:
+    """Neural net object (reference cxxnet.py:108-280).
+
+    ``dev`` selects the accelerator ('tpu' is the default; 'cpu' forces
+    the host platform — useful for debugging; 'gpu:<n>' strings from
+    reference configs are accepted and treated as the default device).
+    ``cfg`` is config text with the netconfig block and globals.
+    """
+
+    def __init__(self, dev: str = "tpu", cfg: str = ""):
+        if dev.startswith("cpu"):
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        self._cfg = parse_config(cfg) if cfg else []
+        if self._cfg:
+            self._validate_netconfig(self._cfg)
+        self._extra: List[Tuple[str, str]] = []
+        self._trainer: Optional[NetTrainer] = None
+        self._round = 0
+
+    @staticmethod
+    def _validate_netconfig(cfg) -> None:
+        """Reject bad structure/layer types at creation time, so C ABI
+        callers get NULL from CXNNetCreate instead of a deferred
+        failure (the reference net is built eagerly in CXNNetCreate)."""
+        from .graph import NetGraph
+        from .layers import known_layer_type
+        g = NetGraph()
+        g.configure(cfg)
+        for li, info in enumerate(g.layers):
+            if info.type == "share":
+                continue
+            if not known_layer_type(info.type):
+                raise ValueError("unknown layer type %r (layer %d)"
+                                 % (info.type, li))
+
+    # -- config / lifecycle ---------------------------------------------
+
+    def set_param(self, name, value) -> None:
+        self._extra.append((str(name), str(value)))
+
+    def _make_trainer(self) -> NetTrainer:
+        if self._trainer is None:
+            self._trainer = NetTrainer(list(self._cfg) + self._extra)
+        return self._trainer
+
+    def init_model(self) -> None:
+        self._make_trainer().init_model()
+
+    def load_model(self, fname: str) -> None:
+        self._make_trainer().load_model(fname)
+
+    def save_model(self, fname: str) -> None:
+        self._req().save_model(fname)
+
+    def _req(self) -> NetTrainer:
+        if self._trainer is None or not self._trainer._initialized:
+            raise RuntimeError("call init_model or load_model first")
+        return self._trainer
+
+    def start_round(self, round_counter: int) -> None:
+        self._round = round_counter
+        self._req().start_round(round_counter)
+
+    # -- data plumbing ---------------------------------------------------
+
+    def _to_batch(self, data, label=None) -> DataBatch:
+        if isinstance(data, DataIter):
+            return data.batch
+        data = np.asarray(data, np.float32)
+        t = self._req()
+        is_mat = t.net.node_shapes[0].is_mat
+        arr = _nchw_to_internal(data, is_mat)
+        if label is not None:
+            label = np.asarray(label, np.float32)
+            if label.ndim == 1:
+                label = label.reshape(-1, 1)
+            if label.ndim != 2:
+                raise ValueError("label must be 1-D or 2-D")
+            if label.shape[0] != arr.shape[0]:
+                raise ValueError("Net.update: data size mismatch")
+        return DataBatch(data=arr, label=label)
+
+    # -- training / inference --------------------------------------------
+
+    def update(self, data, label=None):
+        """One training step on a batch (DataIter or NCHW ndarray+label)."""
+        if isinstance(data, np.ndarray) and label is None:
+            raise ValueError("Net.update: need label to use update")
+        self._req().update(self._to_batch(data, label))
+
+    def evaluate(self, data, name: str) -> str:
+        """Full eval pass over a DataIter; returns the metric string."""
+        if not isinstance(data, DataIter):
+            raise TypeError("evaluate needs a DataIter")
+        return self._req().evaluate(iter(data), name)
+
+    def predict(self, data) -> np.ndarray:
+        """Predicted class index (or scalar output) per row."""
+        if isinstance(data, DataIter):
+            return self._req().predict(data.batch)
+        return self._req().predict(self._to_batch(data))
+
+    def extract(self, data, name: str) -> np.ndarray:
+        """Extract a named node's activations ('top[-k]' supported)."""
+        batch = data.batch if isinstance(data, DataIter) \
+            else self._to_batch(data)
+        out = self._req().extract_feature(batch, name)
+        return _internal_to_nchw(out)      # flat nodes -> (b,1,1,f)
+
+    # -- weights ---------------------------------------------------------
+
+    def set_weight(self, weight: np.ndarray, layer_name: str,
+                   tag: str) -> None:
+        if tag not in ("bias", "wmat"):
+            raise ValueError("tag must be bias or wmat")
+        t = self._req()
+        weight = np.asarray(weight, np.float32)
+        cur = t.get_weight(layer_name, tag)     # reference-layout shape
+        if weight.shape != cur.shape:
+            if weight.size != cur.size:
+                raise ValueError(
+                    "set_weight %s:%s: size %d does not match %d"
+                    % (layer_name, tag, weight.size, cur.size))
+            weight = weight.reshape(cur.shape)  # flat C-ABI input
+        t.set_weight(layer_name, tag, weight)
+
+    def get_weight(self, layer_name: str, tag: str) -> Optional[np.ndarray]:
+        if tag not in ("bias", "wmat"):
+            raise ValueError("tag must be bias or wmat")
+        t = self._req()
+        if layer_name not in t.params or tag not in t.params[layer_name]:
+            return None
+        return t.get_weight(layer_name, tag)
+
+
+def train(cfg: str, data, num_round: int, param, eval_data=None,
+          label=None) -> Net:
+    """Train a net from config text (reference cxxnet.py:281-308).
+
+    data: DataIter, or NCHW ndarray with ``label``.
+    param: dict or (key, value) pairs applied via set_param.
+    """
+    net = Net(cfg=cfg)
+    if isinstance(param, dict):
+        param = param.items()
+    for k, v in param:
+        net.set_param(k, v)
+    net.init_model()
+    if isinstance(data, DataIter):
+        for r in range(num_round):
+            net.start_round(r)
+            data.before_first()
+            scounter = 0
+            while data.next():
+                net.update(data)
+                scounter += 1
+                if scounter % 100 == 0:
+                    print("[%d] %d batch passed" % (r, scounter))
+            if eval_data is not None:
+                seval = net.evaluate(eval_data, "eval")
+                print("[%d]%s" % (r, seval))
+    else:
+        if label is None:
+            raise ValueError("train from ndarray needs label=")
+        for r in range(num_round):
+            net.start_round(r)
+            net.update(data=data, label=label)
+    return net

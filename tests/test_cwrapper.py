@@ -1,0 +1,255 @@
+"""C ABI wrapper (wrapper/cxxnet_wrapper.{h,cc}).
+
+Two modes: (a) ctypes-load the shared library into this process — the
+embedded-interpreter code path detects the live interpreter and only
+takes the GIL; (b) compile and run a real standalone C program against
+the ABI — the true embedding path where the library owns the
+interpreter (what a C or Matlab host would do).
+"""
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIBPATH = os.path.join(REPO, "lib", "libcxxnet_wrapper.so")
+
+
+def _ensure_built() -> bool:
+    if os.path.exists(LIBPATH):
+        return True
+    try:
+        subprocess.check_call(["make", "-s", "-C", REPO,
+                               "lib/libcxxnet_wrapper.so"],
+                              stdout=subprocess.DEVNULL,
+                              stderr=subprocess.DEVNULL)
+    except (OSError, subprocess.CalledProcessError):
+        return False
+    return os.path.exists(LIBPATH)
+
+
+pytestmark = pytest.mark.skipif(not _ensure_built(),
+                                reason="wrapper lib not built")
+
+NET_CFG = b"""
+netconfig = start
+layer[0->1] = fullc:fc1
+  nhidden = 16
+layer[1->2] = relu
+layer[2->3] = fullc:fc2
+  nhidden = 4
+layer[3->3] = softmax
+netconfig = end
+input_shape = 1,1,10
+batch_size = 8
+eta = 0.2
+metric = error
+"""
+
+
+def _load():
+    lib = ctypes.CDLL(LIBPATH)
+    lib.CXNNetCreate.restype = ctypes.c_void_p
+    lib.CXNNetCreate.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.CXNNetPredictBatch.restype = ctypes.POINTER(ctypes.c_float)
+    lib.CXNNetGetWeight.restype = ctypes.POINTER(ctypes.c_float)
+    lib.CXNNetEvaluate.restype = ctypes.c_char_p
+    lib.CXNGetLastError.restype = ctypes.c_char_p
+    lib.CXNIOCreateFromConfig.restype = ctypes.c_void_p
+    lib.CXNIOGetData.restype = ctypes.POINTER(ctypes.c_float)
+    lib.CXNIOGetLabel.restype = ctypes.POINTER(ctypes.c_float)
+    for f in (lib.CXNNetFree, lib.CXNNetInitModel, lib.CXNIOFree,
+              lib.CXNIOBeforeFirst):
+        f.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _shape4(*dims):
+    a = (ctypes.c_uint * 4)()
+    for i, d in enumerate(dims):
+        a[i] = d
+    return a
+
+
+def test_c_abi_net_roundtrip():
+    lib = _load()
+    net = lib.CXNNetCreate(b"tpu", NET_CFG)
+    assert net, lib.CXNGetLastError()
+    net = ctypes.c_void_p(net)
+    lib.CXNNetInitModel(net)
+
+    rng = np.random.RandomState(0)
+    X = np.ascontiguousarray(rng.rand(8, 1, 1, 10), np.float32)
+    y = np.ascontiguousarray(
+        rng.randint(0, 4, (8, 1)), np.float32)
+    dshape = _shape4(8, 1, 1, 10)
+    lshape = (ctypes.c_uint * 2)(8, 1)
+    pdata = X.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    plabel = y.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    for r in range(3):
+        lib.CXNNetStartRound(net, r)
+        lib.CXNNetUpdateBatch(net, pdata, dshape, plabel, lshape)
+    assert lib.CXNGetLastError() in (b"",), lib.CXNGetLastError()
+
+    osize = ctypes.c_uint()
+    pred = lib.CXNNetPredictBatch(net, pdata, dshape,
+                                  ctypes.byref(osize))
+    assert osize.value == 8
+    vals = [pred[i] for i in range(8)]
+    assert all(0 <= v <= 3 for v in vals)
+
+    oshape = _shape4()
+    odim = ctypes.c_uint()
+    w = lib.CXNNetGetWeight(net, b"fc1", b"wmat", oshape,
+                            ctypes.byref(odim))
+    assert odim.value == 2 and (oshape[0], oshape[1]) == (16, 10)
+    assert w
+
+    # unknown layer -> NULL, dim 0
+    w2 = lib.CXNNetGetWeight(net, b"nosuch", b"wmat", oshape,
+                             ctypes.byref(odim))
+    assert odim.value == 0 and not w2
+
+    # flat set_weight (the C-ABI calling convention) must reshape
+    # against the stored (out,in) layout, not corrupt it
+    flat = np.full(16 * 10, 0.5, np.float32)
+    lib.CXNNetSetWeight(net, flat.ctypes.data_as(
+        ctypes.POINTER(ctypes.c_float)), 160, b"fc1", b"wmat")
+    w3 = lib.CXNNetGetWeight(net, b"fc1", b"wmat", oshape,
+                             ctypes.byref(odim))
+    assert odim.value == 2 and (oshape[0], oshape[1]) == (16, 10)
+    assert w3[0] == 0.5 and w3[159] == 0.5
+
+    # extract: flat node comes back as documented NCHW (b,1,1,f);
+    # top[-1] is one below the top node (relu out, 16 features)
+    eshape = _shape4()
+    e = lib.CXNNetExtractBatch(net, pdata, dshape, b"top[-1]", eshape)
+    assert e and tuple(eshape) == (8, 1, 1, 16), tuple(eshape)
+    e2 = lib.CXNNetExtractBatch(net, pdata, dshape, b"top", eshape)
+    assert e2 and tuple(eshape) == (8, 1, 1, 4), tuple(eshape)
+
+    lib.CXNNetFree(net)
+
+
+def test_c_abi_iterator(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.rand(32, 10).astype(np.float32)
+    yv = (X @ rng.randn(10, 4)).argmax(1)
+    csv = tmp_path / "d.csv"
+    with open(csv, "w") as f:
+        for i in range(32):
+            f.write(",".join([str(yv[i])] +
+                             ["%.6f" % v for v in X[i]]) + "\n")
+    cfg = ("iter = csv\nfilename = %s\ninput_shape = 1,1,10\n"
+           "label_width = 1\niter = end\nbatch_size = 8\n" % csv).encode()
+
+    lib = _load()
+    it = lib.CXNIOCreateFromConfig(cfg)
+    assert it, lib.CXNGetLastError()
+    it = ctypes.c_void_p(it)
+    n = 0
+    while lib.CXNIONext(it):
+        n += 1
+    assert n == 4
+    lib.CXNIOBeforeFirst(it)
+    assert lib.CXNIONext(it)
+    oshape = _shape4()
+    ostride = ctypes.c_uint()
+    d = lib.CXNIOGetData(it, oshape, ctypes.byref(ostride))
+    assert tuple(oshape) == (8, 1, 1, 10) and ostride.value == 10
+    row0 = np.array([d[i] for i in range(10)], np.float32)
+    np.testing.assert_allclose(row0, X[0], rtol=1e-5)
+    lshape = (ctypes.c_uint * 2)()
+    lab = lib.CXNIOGetLabel(it, lshape, ctypes.byref(ostride))
+    assert tuple(lshape) == (8, 1)
+    assert lab[0] == yv[0]
+
+    # net trained from the iterator handle
+    net = ctypes.c_void_p(lib.CXNNetCreate(b"tpu", NET_CFG))
+    lib.CXNNetInitModel(net)
+    for r in range(2):
+        lib.CXNIOBeforeFirst(it)
+        while lib.CXNIONext(it):
+            lib.CXNNetUpdateIter(net, it)
+    lib.CXNIOBeforeFirst(it)
+    assert lib.CXNIONext(it)
+    s = lib.CXNNetEvaluate(net, it, b"eval")
+    assert b"eval-error:" in s
+    lib.CXNNetFree(net)
+    lib.CXNIOFree(it)
+
+
+C_PROGRAM = r"""
+#include "cxxnet_wrapper.h"
+#include <stdio.h>
+#include <stdlib.h>
+
+static const char *CFG =
+  "netconfig = start\n"
+  "layer[0->1] = fullc:fc1\n"
+  "  nhidden = 16\n"
+  "layer[1->2] = relu\n"
+  "layer[2->3] = fullc:fc2\n"
+  "  nhidden = 4\n"
+  "layer[3->3] = softmax\n"
+  "netconfig = end\n"
+  "input_shape = 1,1,10\n"
+  "batch_size = 8\n"
+  "eta = 0.2\n"
+  "metric = error\n";
+
+int main(void) {
+  void *net = CXNNetCreate("tpu", CFG);
+  if (!net) { fprintf(stderr, "create: %s\n", CXNGetLastError()); return 1; }
+  CXNNetInitModel(net);
+  float data[8 * 10];
+  float label[8];
+  cxn_uint dshape[4] = {8, 1, 1, 10};
+  cxn_uint lshape[2] = {8, 1};
+  unsigned seed = 7;
+  for (int i = 0; i < 8 * 10; ++i) {
+    seed = seed * 1103515245u + 12345u;
+    data[i] = (float)(seed % 1000) / 1000.0f;
+  }
+  for (int i = 0; i < 8; ++i) label[i] = (float)(i % 4);
+  for (int r = 0; r < 3; ++r) {
+    CXNNetStartRound(net, r);
+    CXNNetUpdateBatch(net, data, dshape, label, lshape);
+  }
+  cxn_uint osize = 0;
+  const cxn_real_t *pred = CXNNetPredictBatch(net, data, dshape, &osize);
+  if (!pred || osize != 8) {
+    fprintf(stderr, "predict: %s\n", CXNGetLastError());
+    return 2;
+  }
+  for (cxn_uint i = 0; i < osize; ++i) {
+    if (pred[i] < 0 || pred[i] > 3) return 3;
+  }
+  printf("C-ABI-OK first_pred=%d\n", (int)pred[0]);
+  CXNNetFree(net);
+  return 0;
+}
+"""
+
+
+def test_standalone_c_program(tmp_path):
+    src = tmp_path / "host.c"
+    src.write_text(C_PROGRAM)
+    exe = str(tmp_path / "host")
+    try:
+        subprocess.check_call(
+            ["gcc", str(src), "-I", os.path.join(REPO, "wrapper"),
+             "-L", os.path.join(REPO, "lib"),
+             "-Wl,-rpath," + os.path.join(REPO, "lib"),
+             "-lcxxnet_wrapper", "-o", exe])
+    except (OSError, subprocess.CalledProcessError):
+        pytest.skip("no C toolchain")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"         # fast compile in the subprocess
+    out = subprocess.run([exe], capture_output=True, text=True,
+                         timeout=600, env=env)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "C-ABI-OK" in out.stdout

@@ -1,0 +1,131 @@
+"""Python wrapper API (reference wrapper/cxxnet.py surface)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.wrapper import DataIter, Net, train
+
+NET_CFG = """
+netconfig = start
+layer[0->1] = fullc:fc1
+  nhidden = 16
+layer[1->2] = relu
+layer[2->3] = fullc:fc2
+  nhidden = 4
+layer[3->3] = softmax
+netconfig = end
+input_shape = 1,1,10
+batch_size = 8
+eta = 0.2
+metric = error
+"""
+
+
+def _csv_file(tmp_path, n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 10).astype(np.float32)
+    y = (X @ rng.randn(10, 4)).argmax(1)
+    p = tmp_path / "d.csv"
+    with open(p, "w") as f:
+        for i in range(n):
+            f.write(",".join([str(y[i])] +
+                             ["%.6f" % v for v in X[i]]) + "\n")
+    return str(p)
+
+
+def _iter_cfg(path):
+    return """
+iter = csv
+  filename = %s
+  input_shape = 1,1,10
+  label_width = 1
+iter = end
+batch_size = 8
+""" % path
+
+
+def test_dataiter(tmp_path):
+    it = DataIter(_iter_cfg(_csv_file(tmp_path)))
+    assert it.head and not it.tail
+    with pytest.raises(RuntimeError):
+        it.get_data()
+    assert it.next()
+    d = it.get_data()
+    assert d.shape == (8, 1, 1, 10)          # NCHW at the API edge
+    lab = it.get_label()
+    assert lab.shape == (8, 1)
+    n = 1
+    while it.next():
+        n += 1
+    assert n == 8
+    assert it.tail
+    it.before_first()
+    assert it.head
+
+
+def test_net_update_ndarray_and_predict():
+    rng = np.random.RandomState(0)
+    X = rng.rand(8, 1, 1, 10).astype(np.float32)     # NCHW
+    y = rng.randint(0, 4, (8,)).astype(np.float32)
+    net = Net(cfg=NET_CFG)
+    net.set_param("eta", "0.1")
+    net.init_model()
+    with pytest.raises(ValueError):
+        net.update(X)                                 # no label
+    for r in range(3):
+        net.start_round(r)
+        net.update(X, y)
+    pred = net.predict(X)
+    assert pred.shape == (8,)
+    assert set(np.unique(pred)).issubset({0., 1., 2., 3.})
+
+
+def test_net_update_dataiter_and_evaluate(tmp_path):
+    it = DataIter(_iter_cfg(_csv_file(tmp_path)))
+    ev = DataIter(_iter_cfg(_csv_file(tmp_path)))
+    net = train(NET_CFG, it, 3, {"eta": "0.3"}, eval_data=ev)
+    s = net.evaluate(ev, "eval")
+    assert "eval-error:" in s
+    err = float(s.split(":")[-1])
+    assert err < 0.5                          # learned something
+
+
+def test_net_extract_and_weights():
+    rng = np.random.RandomState(0)
+    X = rng.rand(8, 1, 1, 10).astype(np.float32)
+    net = Net(cfg=NET_CFG)
+    net.init_model()
+    feat = net.extract(X, "top[-1]")
+    assert feat.shape[0] == 8
+    w = net.get_weight("fc1", "wmat")
+    assert w is not None and w.shape == (16, 10)   # reference (out,in)
+    w2 = np.ones_like(w)
+    net.set_weight(w2, "fc1", "wmat")
+    np.testing.assert_allclose(net.get_weight("fc1", "wmat"), w2)
+    assert net.get_weight("nosuch", "wmat") is None
+    with pytest.raises(ValueError):
+        net.get_weight("fc1", "gamma")
+
+
+def test_net_save_load(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.rand(8, 1, 1, 10).astype(np.float32)
+    y = rng.randint(0, 4, (8,)).astype(np.float32)
+    net = Net(cfg=NET_CFG)
+    net.init_model()
+    net.update(X, y)
+    p1 = net.predict(X)
+    path = str(tmp_path / "m.npz")
+    net.save_model(path)
+
+    net2 = Net(cfg=NET_CFG)
+    net2.load_model(path)
+    np.testing.assert_allclose(net2.predict(X), p1)
+
+
+def test_net_requires_init():
+    net = Net(cfg=NET_CFG)
+    with pytest.raises(RuntimeError):
+        net.predict(np.zeros((8, 1, 1, 10), np.float32))
