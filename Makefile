@@ -14,7 +14,7 @@ PY_VER := $(shell python3 -c "import sysconfig; print(sysconfig.get_config_var('
 
 LIB = lib/libcxxnet_io.so
 WRAPLIB = lib/libcxxnet_wrapper.so
-TOOLS = bin/im2rec bin/rec2idx
+TOOLS = bin/im2rec bin/rec2idx bin/im2bin bin/bin2rec
 
 # the Python-embedding wrapper needs python3 dev headers; skip when absent
 ifneq ($(PY_CFLAGS),)
@@ -40,6 +40,13 @@ bin/im2rec: tools/im2rec.cc src/io/recordio.cc src/io/recordio.h | bin
 
 bin/rec2idx: tools/rec2idx.cc src/io/recordio.cc src/io/recordio.h | bin
 	$(CXX) $(CXXFLAGS) -o $@ tools/rec2idx.cc src/io/recordio.cc
+
+bin/im2bin: tools/im2bin.cc src/io/binpage.h | bin
+	$(CXX) $(CXXFLAGS) -o $@ tools/im2bin.cc
+
+bin/bin2rec: tools/bin2rec.cc src/io/binpage.h src/io/recordio.cc \
+		src/io/recordio.h | bin
+	$(CXX) $(CXXFLAGS) -o $@ tools/bin2rec.cc src/io/recordio.cc
 
 clean:
 	rm -rf lib bin

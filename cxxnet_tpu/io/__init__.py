@@ -5,9 +5,10 @@ Parity with ``/root/reference/src/io/data.cpp:27-94``: the first
 (``threadbuffer``, ``membuffer``); parameters apply to every iterator in
 the chain (the reference calls SetParam down the chain).
 
-Sources: mnist (batch-level), csv / img (instance-level, auto-wrapped in
-a BatchAdapter like the reference's CreateBatchIter). recordio arrives
-with the native packer (tools/), round 2+.
+Sources: mnist (batch-level); csv / img / imgrec / imgbin (instance
+level, auto-wrapped in a BatchAdapter like the reference's
+CreateBatchIter). Adapters: augment, batch, threadbuffer, membuffer,
+attachtxt.
 """
 
 from __future__ import annotations
@@ -22,8 +23,9 @@ from .iter_mem import MemBufferIterator
 from .iter_img import ImageIterator
 from .iter_imgrec import ImageRecordIterator
 from .iter_augment import AugmentAdapter
+from .iter_attach import AttachTxtIterator
+from .iter_imgbin import ImageBinIterator
 
-_INSTANCE_SOURCES = ("csv", "img", "imgrec")
 
 
 def create_iterator(cfg: Sequence[Tuple[str, str]],
@@ -65,6 +67,13 @@ def create_iterator(cfg: Sequence[Tuple[str, str]],
                 assert it is None, "imgrec must be the base iterator"
                 it = AugmentAdapter(ImageRecordIterator())
                 is_instance_level = True
+            elif val in ("imgbin", "imgbinx", "imgbinold", "imginst"):
+                # one iterator serves all legacy imgbin variants (their
+                # differences were threading strategies; see
+                # iter_imgbin.py)
+                assert it is None, "imgbin must be the base iterator"
+                it = AugmentAdapter(ImageBinIterator())
+                is_instance_level = True
             elif val == "augment":
                 assert it is not None and is_instance_level, \
                     "augment stacks on an instance iterator"
@@ -89,6 +98,12 @@ def create_iterator(cfg: Sequence[Tuple[str, str]],
                     it = BatchAdapter(it)
                     is_instance_level = False
                 it = MemBufferIterator(it)
+            elif val == "attachtxt":
+                assert it is not None, "attachtxt stacks on an iterator"
+                if is_instance_level:
+                    it = BatchAdapter(it)
+                    is_instance_level = False
+                it = AttachTxtIterator(it)
             else:
                 raise ValueError("unknown iterator type %r" % val)
             apply_pending(it)

@@ -1,0 +1,85 @@
+"""Pure-Python reader/writer for the legacy BinaryPage (imgbin) format.
+
+Format defined at src/io/binpage.h (interoperable with archives packed
+by the reference's im2bin, /root/reference/src/utils/io.h:99-171): fixed
+64 MiB int32 pages; word 0 is the object count, words 1..n+1 cumulative
+byte sizes, object bytes packed backward from the page end.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import numpy as np
+
+KPAGE_WORDS = 64 << 18
+KPAGE_BYTES = KPAGE_WORDS * 4
+
+
+def read_pages(path: str) -> Iterator[List[bytes]]:
+    """Yield the list of objects of each page."""
+    with open(path, "rb") as f:
+        while True:
+            raw = f.read(KPAGE_BYTES)
+            if not raw:
+                return
+            if len(raw) < KPAGE_BYTES:
+                raise IOError(
+                    "truncated BinaryPage archive %r: trailing partial "
+                    "page of %d bytes" % (path, len(raw)))
+            words = np.frombuffer(raw, "<i4")
+            n = int(words[0])
+            cum = words[1:n + 2].astype(np.int64)
+            objs = []
+            for r in range(n):
+                a = KPAGE_BYTES - int(cum[r + 1])
+                b = KPAGE_BYTES - int(cum[r])
+                objs.append(raw[a:b])
+            yield objs
+
+
+def iter_objects(path: str) -> Iterator[bytes]:
+    for objs in read_pages(path):
+        for o in objs:
+            yield o
+
+
+class PageWriter:
+    """Writer matching BinaryPage::Push/Save (used by tests and the
+    pure-Python im2bin fallback path)."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "wb")
+        self._objs: List[bytes] = []
+        self._used = 0                   # payload bytes in current page
+
+    def _free(self) -> int:
+        return (KPAGE_WORDS - (len(self._objs) + 2)) * 4 - self._used
+
+    def write(self, data: bytes) -> None:
+        if len(data) + 4 > self._free():
+            self._flush()
+            if len(data) + 4 > self._free():
+                raise ValueError("object too large for one page")
+        self._objs.append(data)
+        self._used += len(data)
+
+    def _flush(self) -> None:
+        if not self._objs:
+            return
+        page = np.zeros(KPAGE_WORDS, "<i4")
+        page[0] = len(self._objs)
+        buf = page.tobytes()
+        arr = bytearray(buf)
+        cum = 0
+        for r, o in enumerate(self._objs):
+            cum += len(o)
+            np_off = (r + 2) * 4
+            arr[np_off:np_off + 4] = np.int32(cum).tobytes()
+            arr[KPAGE_BYTES - cum:KPAGE_BYTES - cum + len(o)] = o
+        self._f.write(bytes(arr))
+        self._objs, self._used = [], 0
+
+    def close(self) -> None:
+        self._flush()
+        self._f.close()

@@ -1,0 +1,83 @@
+"""attachtxt: join per-instance side data into ``batch.extra_data``.
+
+Reference: ``/root/reference/src/io/iter_attach_txt-inl.hpp:15-101``.
+File format: first token is the data dim, then rows of
+``<instance_id> <v1> ... <vdim>``. At each batch the adapter looks up
+every instance index and fills an ``(batch, dim)`` float matrix, handed
+to the net as extra input node ``in_1`` (``extra_data_num = 1``,
+``extra_data_shape[0] = 1,1,<dim>`` in the netconfig).
+
+Instances missing from the file get zeros (the reference leaves stale
+buffer contents for those rows — an accident of buffer reuse, not a
+semantic worth keeping).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from .data import DataBatch, IIterator
+
+
+class AttachTxtIterator(IIterator):
+    """Batch-level adapter stacking on a batch iterator."""
+
+    def __init__(self, base: IIterator):
+        self.base = base
+        self.filename = ""
+        self.dim = 0
+        self._rows: Dict[int, np.ndarray] = {}
+        self._out: DataBatch = None
+
+    def set_param(self, name: str, val: str) -> None:
+        # 'filename' set after the attachtxt line is the side-data file
+        # and is consumed here; everything else forwards down the chain
+        # (the reference forwarded everything, which only worked because
+        # its base iterators used different param names)
+        if name == "filename":
+            self.filename = val
+            return
+        self.base.set_param(name, val)
+
+    def init(self) -> None:
+        self.base.init()
+        assert self.filename, "attachtxt: filename must be set"
+        with open(self.filename, "r") as f:
+            tokens = f.read().split()
+        assert tokens, "attachtxt: empty file %s" % self.filename
+        self.dim = int(tokens[0])
+        assert self.dim > 0, "attachtxt: dim must be positive"
+        pos = 1
+        assert (len(tokens) - 1) % (self.dim + 1) == 0, \
+            "attachtxt: data do not match dimension specified"
+        while pos < len(tokens):
+            inst_id = int(tokens[pos])
+            vals = np.asarray([float(t) for t in
+                               tokens[pos + 1:pos + 1 + self.dim]],
+                              np.float32)
+            self._rows[inst_id] = vals
+            pos += self.dim + 1
+
+    def before_first(self) -> None:
+        self.base.before_first()
+
+    def next(self) -> bool:
+        if not self.base.next():
+            return False
+        b = self.base.value()
+        extra = np.zeros((b.batch_size, self.dim), np.float32)
+        if b.inst_index is not None:
+            for i, idx in enumerate(np.asarray(b.inst_index)):
+                row = self._rows.get(int(idx))
+                if row is not None:
+                    extra[i] = row
+        self._out = DataBatch(data=b.data, label=b.label,
+                              inst_index=b.inst_index,
+                              num_batch_padd=b.num_batch_padd,
+                              extra_data=[extra])
+        return True
+
+    def value(self) -> DataBatch:
+        return self._out

@@ -230,13 +230,13 @@ class NetTrainer:
             return new_p, new_o
 
         def train_step(params, opt_state, net_state, grad_acc,
-                       data, labels, mask, hyper_arr, base_key,
+                       data, labels, mask, extra, hyper_arr, base_key,
                        do_update):
             step = hyper_arr[0, 4].astype(jnp.uint32)
             rng = jax.random.fold_in(base_key, step)
             (loss, (new_state, preds)), grads = jax.value_and_grad(
                 net.loss_fn, has_aux=True)(
-                    params, net_state, data, labels, mask,
+                    params, net_state, data, labels, mask, extra=extra,
                     rng=rng, collect_nodes=metric_nodes)
             if update_period == 1:
                 params, opt_state = apply_updates(
@@ -258,8 +258,9 @@ class NetTrainer:
         self._train_step = jax.jit(train_step, donate_argnums=donate,
                                    static_argnames=("do_update",))
 
-        def pred_step(params, net_state, data, nodes_wanted):
+        def pred_step(params, net_state, data, extra, nodes_wanted):
             node_vals, _, _ = net.forward(params, net_state, data,
+                                          extra=extra,
                                           is_train=False, rng=None)
             return [node_vals[i] for i in nodes_wanted]
 
@@ -302,7 +303,10 @@ class NetTrainer:
         data = self._put_batch_array(batch.data)
         labels = self._put_batch_array(batch.label)
         mask = self._put_batch_array(self._mask(batch))
-        return data, labels, mask
+        return data, labels, mask, self._device_extra(batch)
+
+    def _device_extra(self, batch: DataBatch):
+        return tuple(self._put_batch_array(e) for e in batch.extra_data)
 
     # -- public API ------------------------------------------------------
 
@@ -311,13 +315,13 @@ class NetTrainer:
 
     def update(self, batch: DataBatch) -> None:
         assert self._initialized, "call init_model/load_model first"
-        data, labels, mask = self._device_batch(batch)
+        data, labels, mask, extra = self._device_batch(batch)
         hyper = self._hyper()
         self.sample_counter += 1
         do_update = self.sample_counter >= self.update_period
         out = self._train_step(self.params, self.opt_state,
                                self.net_state, self.grad_acc,
-                               data, labels, mask, hyper,
+                               data, labels, mask, extra, hyper,
                                self._base_key,
                                do_update=bool(do_update))
         (self.params, self.opt_state, self.net_state,
@@ -348,6 +352,7 @@ class NetTrainer:
             data = jax.device_put(np.asarray(batch.data, np.float32),
                                   self._b_shard)
             vals = self._pred_step(self.params, self.net_state, data,
+                                   self._device_extra(batch),
                                    nodes_wanted=nodes_wanted)
             nvalid = batch.batch_size - batch.num_batch_padd
             pred_np = [np.asarray(as_mat(v))[:nvalid] for v in vals]
@@ -363,6 +368,7 @@ class NetTrainer:
         data = jax.device_put(np.asarray(batch.data, np.float32),
                               self._b_shard)
         (val,) = self._pred_step(self.params, self.net_state, data,
+                                 self._device_extra(batch),
                                  nodes_wanted=(top,))
         m = np.asarray(as_mat(val))
         nvalid = batch.batch_size - batch.num_batch_padd
@@ -376,6 +382,7 @@ class NetTrainer:
         data = jax.device_put(np.asarray(batch.data, np.float32),
                               self._b_shard)
         (val,) = self._pred_step(self.params, self.net_state, data,
+                                 self._device_extra(batch),
                                  nodes_wanted=(ni,))
         nvalid = batch.batch_size - batch.num_batch_padd
         return np.asarray(val)[:nvalid]

@@ -1,0 +1,79 @@
+/*!
+ * \file binpage.h
+ * \brief the legacy "imgbin" BinaryPage archive format: fixed 64 MiB
+ *  pages of packed binary objects, interoperable with archives packed
+ *  by the reference's im2bin (format defined at
+ *  /root/reference/src/utils/io.h:99-171, tools/im2bin.cpp:7-68).
+ *
+ * On-disk page layout (int32 words, little-endian), page size
+ * kPageWords * 4 = 64 MiB:
+ *   word[0]          = n  (number of objects)
+ *   word[1]          = 0
+ *   word[r+1], r=1..n = cumulative byte size after object r-1
+ *   object r's bytes occupy [pagesize - cum[r+1], pagesize - cum[r])
+ *   (objects pack backward from the end of the page; bytes of each
+ *    object are in forward order)
+ */
+#ifndef CXXNET_TPU_IO_BINPAGE_H_
+#define CXXNET_TPU_IO_BINPAGE_H_
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+namespace cxxnet_tpu {
+
+class BinaryPage {
+ public:
+  static const size_t kPageWords = 64 << 18;          // 64 MiB of int32
+  static const size_t kPageBytes = kPageWords * 4;
+
+  BinaryPage() : data_(kPageWords, 0) {}
+
+  void Clear() { std::fill(data_.begin(), data_.end(), 0); }
+
+  int Size() const { return data_[0]; }
+
+  /*! \brief try to append an object; false when the page is full */
+  bool Push(const void *dptr, size_t sz) {
+    if (FreeBytes() < sz + sizeof(int32_t)) return false;
+    int n = Size();
+    data_[n + 2] = data_[n + 1] + static_cast<int32_t>(sz);
+    std::memcpy(Offset(data_[n + 2]), dptr, sz);
+    data_[0] = n + 1;
+    return true;
+  }
+
+  /*! \brief object r: pointer + size */
+  const void *Get(int r, size_t *sz) const {
+    *sz = static_cast<size_t>(data_[r + 2] - data_[r + 1]);
+    return Offset(data_[r + 2]);
+  }
+
+  bool Load(std::FILE *fp) {
+    return std::fread(data_.data(), 4, kPageWords, fp) == kPageWords;
+  }
+
+  bool Save(std::FILE *fp) const {
+    return std::fwrite(data_.data(), 4, kPageWords, fp) == kPageWords;
+  }
+
+ private:
+  size_t FreeBytes() const {
+    return (kPageWords - (Size() + 2)) * sizeof(int32_t)
+        - static_cast<size_t>(data_[Size() + 1]);
+  }
+  const void *Offset(int32_t pos) const {
+    return reinterpret_cast<const char *>(data_.data()) + kPageBytes - pos;
+  }
+  void *Offset(int32_t pos) {
+    return reinterpret_cast<char *>(data_.data()) + kPageBytes - pos;
+  }
+
+  std::vector<int32_t> data_;
+};
+
+}  // namespace cxxnet_tpu
+
+#endif  // CXXNET_TPU_IO_BINPAGE_H_

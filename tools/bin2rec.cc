@@ -1,0 +1,94 @@
+/*!
+ * \file bin2rec.cc
+ * \brief convert a legacy BinaryPage archive (+ its image list, which
+ *  holds the indices/labels the bin format does not store) into a
+ *  RecordIO archive.
+ *
+ * Parity with /root/reference/tools/bin2rec.cc:25-71.
+ * Usage: bin2rec img_list bin_file rec_file [label_width=1]
+ * (extra label columns beyond the first are skipped, as in the
+ *  reference)
+ */
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "../src/io/binpage.h"
+#include "../src/io/recordio.h"
+
+struct ImageRecHeader {
+  uint32_t flag;
+  float label;
+  uint64_t image_id[2];
+};
+
+int main(int argc, char *argv[]) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "Usage: bin2rec img_list bin_file rec_file "
+                 "[label_width=1]\n");
+    return 1;
+  }
+  int label_width = argc > 4 ? std::atoi(argv[4]) : 1;
+  std::ifstream lst(argv[1]);
+  if (!lst.good()) {
+    std::fprintf(stderr, "cannot open %s\n", argv[1]);
+    return 1;
+  }
+  std::FILE *fi = std::fopen(argv[2], "rb");
+  if (fi == nullptr) {
+    std::fprintf(stderr, "cannot open %s\n", argv[2]);
+    return 1;
+  }
+  cxxnet_tpu::RecordIOWriter writer(argv[3]);
+  if (!writer.is_open()) {
+    std::fprintf(stderr, "cannot create %s\n", argv[3]);
+    return 1;
+  }
+  cxxnet_tpu::BinaryPage page;
+  std::string line;
+  size_t imcnt = 0;
+  std::vector<char> blob;
+  while (page.Load(fi)) {
+    for (int i = 0; i < page.Size(); ++i) {
+      if (!std::getline(lst, line)) {
+        std::fprintf(stderr, "bin2rec: image list shorter than bin\n");
+        return 1;
+      }
+      std::istringstream is(line);
+      ImageRecHeader hdr;
+      std::memset(&hdr, 0, sizeof(hdr));
+      double index = 0;
+      float label = 0;
+      if (!(is >> index >> label)) {
+        std::fprintf(stderr, "bin2rec: bad list row: %s\n", line.c_str());
+        return 1;
+      }
+      for (int k = 1; k < label_width; ++k) {
+        float skip;
+        is >> skip;
+      }
+      hdr.image_id[0] = static_cast<uint64_t>(index);
+      hdr.label = label;
+      size_t sz = 0;
+      const void *dptr = page.Get(i, &sz);
+      blob.resize(sizeof(hdr) + sz);
+      std::memcpy(blob.data(), &hdr, sizeof(hdr));
+      std::memcpy(blob.data() + sizeof(hdr), dptr, sz);
+      writer.WriteRecord(blob.data(), blob.size());
+      ++imcnt;
+    }
+  }
+  std::fclose(fi);
+  writer.Close();
+  if (writer.HasError()) {
+    std::fprintf(stderr, "bin2rec: write failed (disk full?)\n");
+    return 1;
+  }
+  std::printf("bin2rec: converted %zu images\n", imcnt);
+  return 0;
+}

@@ -1,0 +1,83 @@
+/*!
+ * \file im2bin.cc
+ * \brief pack images (raw file bytes, no decode) into a BinaryPage
+ *  archive — the legacy imgbin format.
+ *
+ * Parity with /root/reference/tools/im2bin.cpp:7-68: reads an image
+ * list ("index label path" rows), appends each file's bytes to the
+ * current page, flushing full pages.
+ *
+ * Usage: im2bin image.lst image_root output.bin
+ */
+#include <cstdio>
+#include <cstdlib>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "../src/io/binpage.h"
+
+int main(int argc, char *argv[]) {
+  if (argc != 4) {
+    std::fprintf(stderr, "Usage: im2bin image.lst image_root output.bin\n");
+    return 1;
+  }
+  std::ifstream lst(argv[1]);
+  if (!lst.good()) {
+    std::fprintf(stderr, "cannot open %s\n", argv[1]);
+    return 1;
+  }
+  std::string root = argv[2];
+  if (!root.empty() && root.back() != '/') root += '/';
+  std::FILE *out = std::fopen(argv[3], "wb");
+  if (out == nullptr) {
+    std::fprintf(stderr, "cannot create %s\n", argv[3]);
+    return 1;
+  }
+  cxxnet_tpu::BinaryPage page;
+  size_t imcnt = 0, pgcnt = 0;
+  std::string line;
+  bool write_ok = true;
+  while (std::getline(lst, line)) {
+    if (line.empty()) continue;
+    std::istringstream is(line);
+    double index, label;
+    std::string path;
+    if (!(is >> index >> label >> path)) continue;
+    std::ifstream img(root + path, std::ios::binary);
+    if (!img.good()) {
+      std::fprintf(stderr, "im2bin: cannot open image %s\n",
+                   (root + path).c_str());
+      return 1;
+    }
+    std::vector<char> bytes((std::istreambuf_iterator<char>(img)),
+                            std::istreambuf_iterator<char>());
+    if (bytes.size() + 16 > cxxnet_tpu::BinaryPage::kPageBytes) {
+      std::fprintf(stderr, "im2bin: image %s too large for one page\n",
+                   path.c_str());
+      return 1;
+    }
+    if (!page.Push(bytes.data(), bytes.size())) {
+      write_ok = write_ok && page.Save(out);
+      page.Clear();
+      ++pgcnt;
+      if (!page.Push(bytes.data(), bytes.size())) {
+        std::fprintf(stderr, "im2bin: image %s too large\n", path.c_str());
+        return 1;
+      }
+    }
+    ++imcnt;
+  }
+  if (page.Size() != 0) {
+    write_ok = write_ok && page.Save(out);
+    ++pgcnt;
+  }
+  if (std::fclose(out) != 0) write_ok = false;
+  if (!write_ok) {
+    std::fprintf(stderr, "im2bin: write failed (disk full?)\n");
+    return 1;
+  }
+  std::printf("im2bin: packed %zu images into %zu pages\n", imcnt, pgcnt);
+  return 0;
+}
