@@ -67,17 +67,15 @@ class PageWriter:
     def _flush(self) -> None:
         if not self._objs:
             return
-        page = np.zeros(KPAGE_WORDS, "<i4")
-        page[0] = len(self._objs)
-        buf = page.tobytes()
-        arr = bytearray(buf)
+        arr = bytearray(KPAGE_BYTES)
+        arr[0:4] = np.int32(len(self._objs)).tobytes()
         cum = 0
         for r, o in enumerate(self._objs):
             cum += len(o)
             np_off = (r + 2) * 4
             arr[np_off:np_off + 4] = np.int32(cum).tobytes()
             arr[KPAGE_BYTES - cum:KPAGE_BYTES - cum + len(o)] = o
-        self._f.write(bytes(arr))
+        self._f.write(arr)
         self._objs, self._used = [], 0
 
     def close(self) -> None:

@@ -91,6 +91,15 @@ class ImageBinIterator(IIterator):
         if self._pool is not None:
             self._pool.shutdown(wait=False)
         self._pool = ThreadPoolExecutor(max_workers=self.nthread)
+        if self.num_parts == 1 and len(self.image_bin) > 1:
+            # process-rank autodetect, the PS_RANK sniffing of the
+            # reference (iter_thread_imbin_x-inl.hpp:116-118). Only for
+            # multi-shard configs: a single explicit bin file is read
+            # whole by every worker, as in the reference.
+            import jax
+            if jax.process_count() > 1:
+                self.num_parts = jax.process_count()
+                self.part_index = jax.process_index()
         self._shards = self._my_shards()
         # parse the (possibly huge) list files once, not per epoch
         self._shard_rows = [self._read_list(lst)

@@ -45,8 +45,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..graph import NetGraph
 from ..io.data import DataBatch
 from ..layers import as_mat
-from ..parallel import (batch_sharding, make_mesh, param_sharding,
-                        replicated)
+from ..parallel import (batch_sharding, make_mesh, opt_state_sharding,
+                        param_sharding, replicated)
 from ..updater import create_updater
 from ..utils.config import ConfigPairs
 from ..utils.metric import MetricSet
@@ -74,6 +74,7 @@ class NetTrainer:
         self.seed = 0
         self.silent = 0
         self.model_parallel_min = 0      # 0 = no model-parallel sharding
+        self.shard_optimizer = 0         # ZeRO-1 (update_on_server analogue)
         self.sample_counter = 0          # within accumulation window
         self.update_counter = 0          # applied updates (schedule epoch)
         self.round = 0
@@ -99,6 +100,11 @@ class NetTrainer:
                 self.silent = int(val)
             if name == "model_parallel_min":
                 self.model_parallel_min = int(val)
+            if name in ("shard_optimizer", "update_on_server"):
+                # update_on_server=1 meant "optimizer state lives off the
+                # workers" (nnet_ps_server.cpp); here it means "optimizer
+                # state is ZeRO-sharded across the data axis"
+                self.shard_optimizer = int(val)
             m = _RE_METRIC.match(name)
             if m:
                 spec = m.group(1)
@@ -170,13 +176,9 @@ class NetTrainer:
             self.net_state,
             jax.tree_util.tree_map(lambda _: self._repl, self.net_state))
         # optimizer state mirrors its weight's sharding (momentum of a
-        # model-sharded fullc weight shards the same way)
-        opt_shard = {
-            lk: {tag: jax.tree_util.tree_map(
-                lambda _: self._p_shard[lk][tag], st)
-                for tag, st in tags.items()}
-            for lk, tags in self.opt_state.items()}
-        self.opt_state = jax.device_put(self.opt_state, opt_shard)
+        # model-sharded fullc weight shards the same way), or is ZeRO-1
+        # sharded across 'data' when shard_optimizer is set
+        self.opt_state = jax.device_put(self.opt_state, self._o_shard)
         if self.update_period > 1:
             self.grad_acc = jax.device_put(
                 _tree_zeros_like(self.params), self._p_shard)
@@ -192,6 +194,15 @@ class NetTrainer:
         self._repl_leaf = self._repl
         self._p_shard = param_sharding(mesh, self.params,
                                        self.model_parallel_min)
+        # optimizer-state shardings (ZeRO-1 over 'data' when enabled)
+        self._o_shard = {
+            lk: {tag: jax.tree_util.tree_map(
+                lambda leaf, _ps=self._p_shard[lk][tag]: opt_state_sharding(
+                    leaf.shape, _ps.spec, mesh,
+                    bool(self.shard_optimizer)),
+                st)
+                for tag, st in tags.items()}
+            for lk, tags in self.opt_state.items()}
         net = self.net
         metric_nodes = tuple(self._metric_nodes)
         update_period = self.update_period
@@ -255,8 +266,18 @@ class NetTrainer:
             return params, opt_state, new_state, grad_acc, loss, preds
 
         donate = (0, 1, 3) if update_period > 1 else (0, 1)
+        # pin output shardings: without this, GSPMD propagation from the
+        # ZeRO-sharded optimizer state drifts the *weights* into a
+        # data-sharded layout too (ZeRO-3-like), forcing an all-gather
+        # in every forward pass
+        ns_shard = jax.tree_util.tree_map(lambda _: self._repl,
+                                          self.net_state)
+        acc_shard = self._p_shard if update_period > 1 else None
+        out_shardings = (self._p_shard, self._o_shard, ns_shard,
+                         acc_shard, self._repl, self._b_shard)
         self._train_step = jax.jit(train_step, donate_argnums=donate,
-                                   static_argnames=("do_update",))
+                                   static_argnames=("do_update",),
+                                   out_shardings=out_shardings)
 
         def pred_step(params, net_state, data, extra, nodes_wanted):
             node_vals, _, _ = net.forward(params, net_state, data,

@@ -78,21 +78,29 @@ def param_sharding(mesh: Mesh, params, model_parallel_min: int = 0):
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
-def opt_sharding_like(param_shardings, mesh: Mesh, shard_data: bool = False):
-    """Optimizer-state sharding: mirrors the parameter shardings, or
-    ZeRO-style sharded over 'data' when shard_data (the update_on_server
-    capability analogue — optimizer state no longer replicated)."""
-    if not shard_data:
-        return param_shardings
+def opt_state_sharding(leaf_shape, param_spec: P, mesh: Mesh,
+                       shard_data: bool) -> NamedSharding:
+    """Sharding for one optimizer-state leaf (momentum / adam moments).
 
-    dsize = mesh.shape["data"]
-
-    def spec(s):
-        # shard the leading dim across 'data' when possible
-        return NamedSharding(mesh, P("data"))
-
-    return jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, P()), param_shardings)
+    Default: mirror its weight's sharding. With ``shard_data`` (the
+    ``update_on_server=1`` capability analogue — optimizer state leaves
+    the replicated pool, like it lived on the server in the reference),
+    leaves whose first dim divides the 'data' axis are ZeRO-1 sharded
+    across it; XLA then keeps the optimizer update sharded and
+    all-gathers only the weights.
+    """
+    if shard_data:
+        dsize = mesh.shape["data"]
+        if (len(leaf_shape) >= 1 and leaf_shape[0] % dsize == 0
+                and leaf_shape[0] >= dsize
+                and (len(param_spec) == 0 or param_spec[0] is None)):
+            # compose with the weight's own axes (a model-sharded fullc
+            # weight's momentum shards on BOTH 'data' and 'model')
+            rest = tuple(param_spec)[1:] if len(param_spec) > 1 else ()
+            spec = ("data",) + rest + (None,) * (
+                len(leaf_shape) - 1 - len(rest))
+            return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, P(*param_spec))
 
 
 def init_distributed(coordinator: Optional[str] = None,

@@ -1,0 +1,1 @@
+"""Model/dataset conversion tools (reference tools/ directory parity)."""

@@ -1,0 +1,123 @@
+"""External-model weight importer — the caffe-converter equivalent.
+
+Reference: ``/root/reference/tools/caffe_converter/convert.cpp:29-187``,
+which instantiates the target cxxnet net from its config, walks the
+source framework's layers, and injects conv/fc blobs into same-named
+layers via SetWeight visitors. Same flow here with torch (CPU) or .npz
+as the source:
+
+    python -m cxxnet_tpu.tools.convert <src.pth|src.npz> <net.conf> \
+        <out.model.npz> [name_map.txt]
+
+Source keys follow the torch convention ``<module>.weight`` /
+``<module>.bias`` (npz files use the same key shape). Layers are matched
+to target layer names automatically; ``name_map.txt`` rows
+``<src_module> <target_layer>`` override. Layouts converted:
+
+- Linear ``(out, in)``      -> fullc wmat (reference layout, set as-is)
+- Conv2d ``(O, I, kh, kw)`` -> conv wmat ``(out, in*kh*kw)`` (the
+  reference visitor layout; internally re-laid-out to HWIO for the MXU)
+- 1-D bias                  -> bias
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..nnet.trainer import NetTrainer
+from ..utils.config import parse_config_file
+
+
+def load_source(path: str) -> Dict[str, np.ndarray]:
+    """Load a torch state dict (.pth/.pt) or a .npz into flat arrays."""
+    if path.endswith(".npz"):
+        blob = np.load(path)
+        return {k: np.asarray(blob[k]) for k in blob.files}
+    import torch
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    return {k: v.detach().cpu().numpy() for k, v in sd.items()
+            if hasattr(v, "detach")}
+
+
+def to_ref_layout(w: np.ndarray) -> Optional[np.ndarray]:
+    """Source array -> reference SetWeight layout; None if unsupported."""
+    if w.ndim == 1 or w.ndim == 2:
+        return w                                  # bias / Linear (out,in)
+    if w.ndim == 4:                               # Conv OIHW
+        o, i, kh, kw = w.shape
+        return w.reshape(o, i * kh * kw)
+    return None
+
+
+def convert(src_path: str, conf_path: str, out_path: str,
+            map_path: Optional[str] = None, silent: bool = False) -> int:
+    src = load_source(src_path)
+    name_map: Dict[str, str] = {}
+    if map_path:
+        with open(map_path) as f:
+            for line in f:
+                toks = line.split()
+                if len(toks) >= 2:
+                    name_map[toks[0]] = toks[1]
+
+    trainer = NetTrainer(parse_config_file(conf_path))
+    trainer.init_model()
+
+    # group source keys by module prefix
+    modules: Dict[str, Dict[str, np.ndarray]] = {}
+    for k, v in src.items():
+        if "." not in k:
+            continue
+        prefix, leaf = k.rsplit(".", 1)
+        modules.setdefault(prefix, {})[leaf] = v
+
+    n_copied = 0
+    for prefix, blobs in modules.items():
+        target = name_map.get(prefix, prefix)
+        if target not in trainer.params:
+            continue
+        for leaf, tag in (("weight", "wmat"), ("bias", "bias")):
+            if leaf not in blobs or tag not in trainer.params[target]:
+                continue
+            w = to_ref_layout(np.asarray(blobs[leaf], np.float32))
+            if w is None:
+                print("skip %s.%s: unsupported rank %d"
+                      % (prefix, leaf, blobs[leaf].ndim))
+                continue
+            want = trainer.get_weight(target, tag).shape
+            if tuple(w.shape) != tuple(want):
+                print("skip %s.%s: shape %s does not match %s of %s:%s"
+                      % (prefix, leaf, w.shape, want, target, tag))
+                continue
+            trainer.set_weight(target, tag, w)
+            n_copied += 1
+            if not silent:
+                print("copied %s.%s -> %s:%s %s"
+                      % (prefix, leaf, target, tag, w.shape))
+    if n_copied == 0:
+        print("convert: no weights matched any target layer name")
+        return 1
+    trainer.save_model(out_path)
+    if not silent:
+        print("convert: %d tensors -> %s" % (n_copied, out_path))
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 3:
+        print("Usage: python -m cxxnet_tpu.tools.convert "
+              "<src.pth|src.npz> <net.conf> <out.model.npz> "
+              "[name_map.txt]")
+        return 1
+    return convert(argv[0], argv[1], argv[2],
+                   argv[3] if len(argv) > 3 else None)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

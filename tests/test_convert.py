@@ -1,0 +1,106 @@
+"""Model converter (cxxnet_tpu.tools.convert): torch -> framework
+snapshot with cross-framework output parity — the role the caffe
+adapter/converter played in the reference (SURVEY.md §4.2)."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from cxxnet_tpu.tools.convert import convert
+from cxxnet_tpu.wrapper import Net
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONF = """
+netconfig = start
+layer[0->1] = conv:features
+  kernel_size = 3
+  nchannel = 8
+  stride = 1
+layer[1->2] = relu
+layer[2->3] = flatten
+layer[3->4] = fullc:classifier
+  nhidden = 4
+layer[4->4] = softmax
+netconfig = end
+input_shape = 3,10,10
+batch_size = 4
+"""
+
+
+class TorchNet(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.features = torch.nn.Conv2d(3, 8, 3, stride=1)
+        self.classifier = torch.nn.Linear(8 * 8 * 8, 4)
+
+    def forward(self, x):
+        h = torch.relu(self.features(x))
+        return torch.softmax(self.classifier(h.flatten(1)), dim=1)
+
+
+def test_convert_torch_output_parity(tmp_path):
+    torch.manual_seed(0)
+    tnet = TorchNet()
+    pth = str(tmp_path / "src.pth")
+    torch.save(tnet.state_dict(), pth)
+    conf = str(tmp_path / "net.conf")
+    open(conf, "w").write(CONF)
+    out = str(tmp_path / "out.model.npz")
+
+    assert convert(pth, conf, out, silent=True) == 0
+
+    net = Net(cfg=CONF)
+    net.load_model(out)
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(4, 3, 10, 10).astype(np.float32)
+    with torch.no_grad():
+        ref = tnet(torch.from_numpy(X)).numpy()
+    got = net.extract(X, "top")          # (4,1,1,4) softmax output
+    np.testing.assert_allclose(got.reshape(4, 4), ref, atol=1e-5)
+
+
+def test_convert_name_map_and_mismatch(tmp_path):
+    torch.manual_seed(1)
+    tnet = TorchNet()
+    pth = str(tmp_path / "src.pth")
+    torch.save(tnet.state_dict(), pth)
+    conf = str(tmp_path / "net.conf")
+    # target layer names differ from the torch module names
+    open(conf, "w").write(CONF.replace("conv:features", "conv:c1")
+                              .replace("fullc:classifier", "fullc:fc"))
+    out = str(tmp_path / "out.model.npz")
+
+    # without a map nothing matches
+    assert convert(pth, conf, out, silent=True) == 1
+
+    mp = str(tmp_path / "map.txt")
+    open(mp, "w").write("features c1\nclassifier fc\n")
+    assert convert(pth, conf, out, map_path=mp, silent=True) == 0
+
+    net = Net(cfg=open(conf).read())
+    net.load_model(out)
+    w = net.get_weight("c1", "wmat")
+    ref = tnet.features.weight.detach().numpy().reshape(8, 27)
+    np.testing.assert_allclose(w, ref, atol=1e-6)
+
+
+def test_convert_cli(tmp_path):
+    torch.manual_seed(2)
+    tnet = TorchNet()
+    pth = str(tmp_path / "src.pth")
+    torch.save(tnet.state_dict(), pth)
+    conf = str(tmp_path / "net.conf")
+    open(conf, "w").write(CONF)
+    out = str(tmp_path / "out.model.npz")
+    r = subprocess.run(
+        ["python", "-m", "cxxnet_tpu.tools.convert", pth, conf, out],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(out)
+    assert "copied" in r.stdout

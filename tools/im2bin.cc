@@ -44,7 +44,13 @@ int main(int argc, char *argv[]) {
     std::istringstream is(line);
     double index, label;
     std::string path;
-    if (!(is >> index >> label >> path)) continue;
+    if (!(is >> index >> label)) continue;
+    // rest of line is the path — may contain spaces (reference parses
+    // with fscanf "%[^\n]", im2bin.cpp:29)
+    std::getline(is, path);
+    size_t b = path.find_first_not_of(" \t");
+    if (b == std::string::npos) continue;
+    path = path.substr(b);
     std::ifstream img(root + path, std::ios::binary);
     if (!img.good()) {
       std::fprintf(stderr, "im2bin: cannot open image %s\n",
