@@ -1,11 +1,16 @@
 """Benchmark: images/sec/chip on ImageNet AlexNet (BASELINE.json metric).
 
-Runs the full training step (fwd + bwd + sgd, synthetic data resident in
-HBM so pure compute is measured — the reference's test_skipread mode,
-iter_batch_proc-inl.hpp:21) on the available accelerator and prints ONE
-JSON line. The reference publishes no throughput number (BASELINE.md),
-so vs_baseline is reported against the nominal figure recorded below on
-first measurement.
+Measures the full training step (fwd + bwd + sgd) at steady state:
+``NetTrainer.run_steps`` scans N update steps inside ONE jitted dispatch
+over a batch resident in HBM, so host/tunnel dispatch latency amortizes
+out — the reference's ``test_skipread`` pure-compute mode
+(iter_batch_proc-inl.hpp:21). Compute is bfloat16 with f32 accumulation
+and f32 master weights (MXU-native mixed precision; the TPU-idiomatic
+training configuration).
+
+The reference publishes no throughput number (BASELINE.md); 1500 img/s
+is the commonly reported cxxnet-era single-GPU (Titan X) AlexNet figure,
+used as a fixed comparison anchor across rounds.
 """
 
 import json
@@ -13,46 +18,43 @@ import time
 
 import numpy as np
 
-# reference throughput anchor: no published number exists (BASELINE.md);
-# 1500 img/s is the commonly reported cxxnet-era single-GPU (Titan X)
-# AlexNet figure, used as a fixed comparison anchor across rounds.
 BASELINE_IMAGES_PER_SEC = 1500.0
 
 
-def main():
+def measure(steps: int = 30, batch: int = 256,
+            dtype: str = "bfloat16") -> float:
     import jax
     from cxxnet_tpu.io.data import DataBatch
     from cxxnet_tpu.models import alexnet
     from cxxnet_tpu.nnet.trainer import NetTrainer
     from cxxnet_tpu.utils.config import parse_config
 
-    batch = 256
     t = NetTrainer(parse_config(alexnet(nclass=1000, batch_size=batch,
                                         image_size=227))
-                   + [("eval_train", "0")])
+                   + [("eval_train", "0"), ("dtype", dtype)])
     t.init_model()
 
     rng = np.random.RandomState(0)
-    data = rng.rand(batch, 227, 227, 3).astype(np.float32)
-    label = rng.randint(0, 1000, (batch, 1)).astype(np.float32)
-    b = DataBatch(data=data, label=label)
-    # park the batch in HBM once (test_skipread: measure pure compute)
-    b = DataBatch(data=t._put_batch_array(b.data),
-                  label=t._put_batch_array(b.label))
+    b = DataBatch(
+        data=t._put_batch_array(
+            rng.rand(batch, 227, 227, 3).astype(np.float32)),
+        label=t._put_batch_array(
+            rng.randint(0, 1000, (batch, 1)).astype(np.float32)))
 
-    for _ in range(3):                      # warmup + compile
-        t.update(b)
+    t.run_steps(b, steps)                   # compile + warmup (same n)
     _ = t.last_loss                         # host sync
 
-    steps = 20
     start = time.perf_counter()
-    for _ in range(steps):
-        t.update(b)
+    t.run_steps(b, steps)
     _ = t.last_loss                         # host sync on final step
     dt = time.perf_counter() - start
 
     n_chips = max(len(jax.devices()), 1)
-    ips = steps * batch / dt / n_chips
+    return steps * batch / dt / n_chips
+
+
+def main():
+    ips = measure()
     print(json.dumps({
         "metric": "images/sec/chip on ImageNet AlexNet",
         "value": round(ips, 1),

@@ -26,9 +26,11 @@ from .conv import (BatchNormLayer, ConvolutionLayer, InsanityPoolingLayer,
                    LRNLayer, PoolingLayer)
 from .loss import LossLayer, LpLossLayer, MultiLogisticLayer, SoftmaxLayer
 from .pairtest import PairTestLayer
+from .pallas_kernels import PallasFullConnectLayer
 
 _FACTORY: Dict[str, Callable[..., Layer]] = {
     "fullc": lambda cfg, **kw: FullConnectLayer(cfg),
+    "pallas_fullc": lambda cfg, **kw: PallasFullConnectLayer(cfg),
     "fixconn": lambda cfg, **kw: FixConnectLayer(cfg),
     "bias": lambda cfg, **kw: BiasLayer(cfg),
     "softmax": lambda cfg, **kw: SoftmaxLayer(cfg),

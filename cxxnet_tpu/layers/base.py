@@ -82,6 +82,10 @@ class LayerParam:
     silent: int = 0
     num_input_channel: int = 0
     num_input_node: int = 0
+    # TPU mixed precision: 'bfloat16' casts matmul/conv operands to
+    # bf16 with f32 accumulation (MXU-native); weights/state stay f32.
+    # New knob, no reference equivalent (2015-era f32-only).
+    compute_dtype: str = "float32"
 
     def set_param(self, name: str, val: str) -> None:
         if name == "init_sigma":
@@ -127,6 +131,10 @@ class LayerParam:
             self.silent = int(val)
         if name == "temp_col_max":
             self.temp_col_max = int(val) << 18
+        if name == "dtype":
+            if val not in ("float32", "bfloat16"):
+                raise ValueError("dtype must be float32 or bfloat16")
+            self.compute_dtype = val
 
     def rand_init_weight(self, key: jax.Array, shape: Tuple[int, ...],
                          in_num: int, out_num: int) -> jnp.ndarray:

@@ -65,8 +65,12 @@ class FullConnectLayer(Layer):
 
     def forward(self, params, state, inputs, is_train, rng):
         x = inputs[0]
-        y = jnp.dot(x, params["wmat"],
-                    preferred_element_type=jnp.float32)
+        w = params["wmat"]
+        bf16 = self.param.compute_dtype == "bfloat16"
+        if bf16:
+            x = x.astype(jnp.bfloat16)
+            w = w.astype(jnp.bfloat16)
+        y = jnp.dot(x, w, preferred_element_type=jnp.float32)
         if self.param.no_bias == 0:
             y = y + params["bias"]
         return [y], state

@@ -79,13 +79,23 @@ class ConvolutionLayer(Layer):
     def forward(self, params, state, inputs, is_train, rng):
         p = self.param
         x = inputs[0]
+        w = params["wmat"]
+        bf16 = p.compute_dtype == "bfloat16"
+        if bf16:
+            # both operands bf16, output bf16, upcast after: the conv
+            # VJP requires matching operand/cotangent dtypes (MXU still
+            # accumulates in f32 internally)
+            x = x.astype(jnp.bfloat16)
+            w = w.astype(jnp.bfloat16)
         y = jax.lax.conv_general_dilated(
-            x, params["wmat"],
+            x, w,
             window_strides=(p.stride, p.stride),
             padding=[(p.pad_y, p.pad_y), (p.pad_x, p.pad_x)],
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=p.num_group,
-            preferred_element_type=jnp.float32)
+            preferred_element_type=None if bf16 else jnp.float32)
+        if bf16:
+            y = y.astype(jnp.float32)
         if p.no_bias == 0:
             y = y + params["bias"]
         return [y], state

@@ -1,0 +1,105 @@
+"""Pallas TPU kernels + layers using them.
+
+The reference demonstrated extending its codegen with a hand-written
+CUDA expression Plan (insanity_pooling_layer-inl.hpp:12-220) and
+validated hand kernels against library implementations via pairtest
+(SURVEY.md §4.1). Same roles here: Pallas kernels with custom VJPs,
+validated with ``pairtest-pallas_fullc-fullc`` (tests/test_pallas.py),
+runnable in interpret mode on CPU test meshes.
+
+Kernel: tiled matmul on the MXU — block rows of x and block columns of
+w meet in VMEM, ``jnp.dot`` drives the systolic array with f32
+accumulation. The backward pass reuses the same kernel for both
+gradient GEMMs (dx = dy·wᵀ, dw = xᵀ·dy), exactly the two products the
+reference's hand-written fullc backprop computed
+(fullc_layer-inl.hpp:108-130).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import Shape3
+from .common import FullConnectLayer
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _matmul_kernel(x_ref, w_ref, o_ref):
+    o_ref[:] = jnp.dot(x_ref[:], w_ref[:],
+                       preferred_element_type=jnp.float32)
+
+
+def _pad_to(v: int, m: int) -> int:
+    return (v + m - 1) // m * m
+
+
+@partial(jax.jit, static_argnames=("bm", "bn"))
+def _matmul_pallas_raw(x: jnp.ndarray, w: jnp.ndarray,
+                       bm: int = 256, bn: int = 256) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+
+    m, k = x.shape
+    k2, n = w.shape
+    assert k == k2
+    mp, np_, kp = _pad_to(m, bm), _pad_to(n, bn), _pad_to(k, 8)
+    xp = jnp.pad(x, ((0, mp - m), (0, kp - k)))
+    wp = jnp.pad(w, ((0, kp - k), (0, np_ - n)))
+    out = pl.pallas_call(
+        _matmul_kernel,
+        grid=(mp // bm, np_ // bn),
+        in_specs=[
+            pl.BlockSpec((bm, kp), lambda i, j: (i, 0)),
+            pl.BlockSpec((kp, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+        interpret=_interpret(),
+    )(xp, wp)
+    return out[:m, :n]
+
+
+@jax.custom_vjp
+def matmul(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """x @ w through the Pallas kernel, differentiable."""
+    return _matmul_pallas_raw(x, w)
+
+
+def _matmul_fwd(x, w):
+    return _matmul_pallas_raw(x, w), (x, w)
+
+
+def _matmul_bwd(res, dy):
+    x, w = res
+    dx = _matmul_pallas_raw(dy, w.T).astype(x.dtype)
+    dw = _matmul_pallas_raw(x.T, dy).astype(w.dtype)
+    return dx, dw
+
+
+matmul.defvjp(_matmul_fwd, _matmul_bwd)
+
+
+class PallasFullConnectLayer(FullConnectLayer):
+    """fullc with the matmul lowered through the Pallas kernel
+    (config name ``pallas_fullc``); numerically identical to ``fullc``
+    — pairtest-pallas_fullc-fullc must report zero divergence."""
+
+    def forward(self, params, state, inputs, is_train, rng):
+        x = inputs[0]
+        w = params["wmat"]
+        if self.param.compute_dtype == "bfloat16":
+            # honor the global dtype knob so pairtest against fullc
+            # stays divergence-free under mixed precision
+            x = x.astype(jnp.bfloat16)
+            w = w.astype(jnp.bfloat16)
+        y = matmul(x, w)
+        if self.param.no_bias == 0:
+            y = y + params["bias"]
+        return [y], state

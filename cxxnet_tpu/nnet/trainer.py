@@ -279,6 +279,29 @@ class NetTrainer:
                                    static_argnames=("do_update",),
                                    out_shardings=out_shardings)
 
+        def multi_step(params, opt_state, net_state, data, labels, mask,
+                       extra, hyper_arr, base_key, n_steps):
+            """n_steps full update steps in ONE dispatch (lax.scan over
+            the same resident batch) — host dispatch latency amortizes
+            to zero; LR/epoch are frozen across the window."""
+            def body(carry, i):
+                p, o, s = carry
+                h = hyper_arr.at[0, 4].add(i.astype(jnp.float32))
+                p, o, s, _, loss, _ = train_step(
+                    p, o, s, None, data, labels, mask, extra, h,
+                    base_key, do_update=True)
+                return (p, o, s), loss
+            (params, opt_state, net_state), losses = jax.lax.scan(
+                body, (params, opt_state, net_state),
+                jnp.arange(n_steps))
+            return params, opt_state, net_state, losses[-1]
+
+        self._multi_step = jax.jit(
+            multi_step, donate_argnums=(0, 1),
+            static_argnames=("n_steps",),
+            out_shardings=(self._p_shard, self._o_shard, ns_shard,
+                           self._repl))
+
         def pred_step(params, net_state, data, extra, nodes_wanted):
             node_vals, _, _ = net.forward(params, net_state, data,
                                           extra=extra,
@@ -357,6 +380,20 @@ class NetTrainer:
             self._train_metrics.add_eval(
                 pred_np, self._label_fields(
                     np.asarray(batch.label, np.float32), nvalid))
+
+    def run_steps(self, batch: DataBatch, n_steps: int) -> None:
+        """Run n_steps full update steps on one resident batch in a
+        single dispatch (steady-state throughput measurement — the
+        test_skipread mode, iter_batch_proc-inl.hpp:21)."""
+        assert self._initialized and self.update_period == 1
+        data, labels, mask, extra = self._device_batch(batch)
+        out = self._multi_step(self.params, self.opt_state,
+                               self.net_state, data, labels, mask,
+                               extra, self._hyper(), self._base_key,
+                               n_steps=int(n_steps))
+        (self.params, self.opt_state, self.net_state, loss) = out
+        self._last_loss = loss
+        self.update_counter += n_steps
 
     def train_metric_str(self, name: str = "train") -> str:
         s = self._train_metrics.print_str(name)
