@@ -29,6 +29,20 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def force_virtual_cpu(n_devices: int) -> None:
+    """Run this process on ``n_devices`` virtual CPU devices — the
+    ps-lite local-mode analogue (SURVEY.md §4.5) used by tests and the
+    driver's multichip dry-run to exercise sharding without TPU chips.
+
+    Must be called before the jax backend initializes.  Uses jax.config
+    (not env vars): this environment preloads jax at interpreter start,
+    so JAX_PLATFORMS in os.environ is read too late, and config wins
+    over a conflicting --xla_force_host_platform_device_count.
+    """
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n_devices)
+
+
 def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
     """Build a (data, model) mesh.
@@ -103,21 +117,46 @@ def opt_state_sharding(leaf_shape, param_spec: P, mesh: Mesh,
     return NamedSharding(mesh, P(*param_spec))
 
 
+_distributed_up = False
+
+
 def init_distributed(coordinator: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None) -> None:
     """Multi-host bring-up over DCN (the rabit::Init / ps-lite tracker
     equivalent, cxxnet_main.cpp:74-91). No-op when single-process or when
-    env vars are absent."""
-    if jax.process_count() > 1:
+    env vars are absent.
+
+    Must run before ANY backend-initializing jax API — so this function
+    deliberately reads only the environment (never jax.process_count(),
+    which would initialize the backend single-process and lock out
+    jax.distributed.initialize).
+    """
+    global _distributed_up
+    if _distributed_up:
         return
+    try:  # a launcher may have called jax.distributed.initialize itself
+        from jax._src import distributed as _jdist
+        if getattr(_jdist.global_state, "client", None) is not None:
+            _distributed_up = True
+            return
+    except Exception:
+        pass
     coordinator = coordinator or os.environ.get("CXXNET_COORDINATOR")
-    if coordinator:
+    if not coordinator:
+        return
+    try:
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=int(num_processes
                               or os.environ["CXXNET_NUM_PROCESSES"]),
             process_id=int(process_id or os.environ["CXXNET_PROCESS_ID"]))
+    except RuntimeError as e:
+        # a launcher beat us to it (the private-module probe above can
+        # miss on future jax versions); already-initialized is success
+        if "already" not in str(e):
+            raise
+    _distributed_up = True
 
 
 def allreduce_host_sum(x: np.ndarray) -> np.ndarray:

@@ -9,6 +9,9 @@ before any backend is initialized.
 
 import os
 
+# env vars are redundant with jax.config for THIS process but are
+# inherited by subprocesses some tests spawn (the embedded-CPython C
+# wrapper test), which must also stay off the real chip
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -17,8 +20,9 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from cxxnet_tpu.parallel import force_virtual_cpu
+
+force_virtual_cpu(8)
 
 import numpy as np
 import pytest

@@ -1,0 +1,152 @@
+"""Multi-process distributed bring-up tests — the ps-lite "local mode"
+equivalent (reference example/multi-machine/run.sh:12-18 runs n workers
+as processes on one machine; SURVEY.md §4.5).
+
+Spawns 2 real OS processes, each a single-device CPU jax process joined
+via ``jax.distributed`` over localhost, and verifies:
+- ``init_distributed`` env bring-up (CXXNET_COORDINATOR et al.) works
+  when called before any other jax API (the round-1 ordering bug)
+- ``allreduce_host_sum`` sums across processes (rabit Allreduce,
+  metric.h:60-68)
+- metric values are globally reduced in ``Metric.get()``
+- only rank 0 is root (root-only save/log, cxxnet_main.cpp:501-503)
+- per-rank data sharding: imgrec autodetects process rank and the two
+  ranks read disjoint record shards that union to the full set
+  (iter_image_recordio-inl.hpp:169-185)
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+import numpy as np
+
+sys.path.insert(0, %(repo)r)
+
+# this environment preloads jax at interpreter start, so JAX_PLATFORMS
+# in the env is read too late; force CPU via jax.config (see conftest)
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+# init_distributed must come before ANY backend-touching jax call
+from cxxnet_tpu.parallel import (init_distributed, rank, world_size,
+                                 is_root, allreduce_host_sum)
+init_distributed()
+
+r = rank()
+assert world_size() == 2, "world_size=%%d" %% world_size()
+assert r == int(os.environ["CXXNET_PROCESS_ID"])
+assert is_root() == (r == 0)
+
+out = allreduce_host_sum(np.array([r + 1.0, 1.0]))
+assert out.tolist() == [3.0, 2.0], out.tolist()
+
+# metric reduction: rank 0 contributes 2 wrong of 3, rank 1 contributes
+# 0 wrong of 1 -> global error = 2/4 = 0.5 (per-rank values differ)
+from cxxnet_tpu.utils.metric import create_metric
+m = create_metric("error")
+if r == 0:
+    m.add_eval(np.array([[0.9, .1], [0.9, .1], [0.9, .1]], np.float32),
+               np.array([[1.], [1.], [0.]], np.float32))
+else:
+    m.add_eval(np.array([[0.9, 0.1]], np.float32),
+               np.array([[0.]], np.float32))
+assert abs(m.get() - 0.5) < 1e-9, m.get()
+
+# per-rank data sharding through the imgrec iterator rank autodetect
+workdir = os.environ["CXXNET_TEST_WORKDIR"]
+from cxxnet_tpu.io.iter_imgrec import ImageRecordIterator
+it = ImageRecordIterator()
+it.set_param("path_imgrec", os.path.join(workdir, "data.rec"))
+it.set_param("silent", "1")
+it.init()
+seen = []
+while it.next():
+    seen.append(int(it.value().index))
+with open(os.path.join(workdir, "shard%%d.txt" %% r), "w") as f:
+    f.write(",".join(map(str, sorted(seen))))
+
+# root-only model save (only rank 0 writes)
+if is_root():
+    with open(os.path.join(workdir, "root.model"), "w") as f:
+        f.write("model")
+print("WORKER%%d OK" %% r)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _pack_rec(path, n=10):
+    cv2 = pytest.importorskip("cv2")
+    from cxxnet_tpu.io.recordio import RecordIOWriter, pack_image_record
+    rng = np.random.RandomState(0)
+    w = RecordIOWriter(path, force_python=True)
+    for i in range(n):
+        img = rng.randint(0, 255, (8, 8, 3), np.uint8)
+        ok, buf = cv2.imencode(".png", img)
+        assert ok
+        w.write_record(pack_image_record(i, float(i % 3),
+                                         bytes(buf.tobytes())))
+    w.close()
+
+
+def test_two_process_bringup(tmp_path):
+    _pack_rec(str(tmp_path / "data.rec"), n=10)
+    script = str(tmp_path / "worker.py")
+    with open(script, "w") as f:
+        f.write(WORKER % {"repo": REPO})
+
+    port = _free_port()
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # no virtual 8-device CPU here
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "CXXNET_COORDINATOR": "127.0.0.1:%d" % port,
+            "CXXNET_NUM_PROCESSES": "2",
+            "CXXNET_PROCESS_ID": str(r),
+            "CXXNET_TEST_WORKDIR": str(tmp_path),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    try:
+        for r, p in enumerate(procs):
+            out, _ = p.communicate(timeout=300)
+            outs.append(out.decode(errors="replace"))
+            assert p.returncode == 0, \
+                "rank %d failed:\n%s" % (r, outs[-1])
+            assert ("WORKER%d OK" % r) in outs[-1], outs[-1]
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+
+    # shards are disjoint and union to the full record set
+    shards = []
+    for r in range(2):
+        with open(tmp_path / ("shard%d.txt" % r)) as f:
+            txt = f.read().strip()
+        shards.append(set(int(t) for t in txt.split(",") if t))
+    assert shards[0] and shards[1], "a rank got an empty shard"
+    assert not (shards[0] & shards[1]), "shards overlap"
+    assert shards[0] | shards[1] == set(range(10))
+
+    # root-only save: the file exists exactly once, written by rank 0
+    assert (tmp_path / "root.model").exists()
