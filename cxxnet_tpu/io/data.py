@@ -66,6 +66,13 @@ class IIterator:
     def value(self):
         raise NotImplementedError
 
+    def close(self) -> None:
+        """Release background resources (threads, pools). Adapters
+        forward to their base; safe to call more than once."""
+        base = getattr(self, "base", None)
+        if base is not None:
+            base.close()
+
     # python-iterator convenience
     def __iter__(self):
         self.before_first()

@@ -44,14 +44,27 @@ class AugmentAdapter(IIterator):
         self.max_random_contrast = 0.0
         self.max_random_illumination = 0.0
         self.silent = 0
-        # affine knobs (image_augmenter)
+        # affine knobs (image_augmenter-inl.hpp:13-104)
         self.max_rotate_angle = 0.0
         self.max_shear_ratio = 0.0
+        self.max_aspect_ratio = 0.0
+        self.min_random_scale = 1.0
+        self.max_random_scale = 1.0
+        self.min_img_size = 0.0
+        self.max_img_size = 1e10
+        self.min_crop_size = -1
+        self.max_crop_size = -1
         self.rotate = -1
         self.rotate_list: List[int] = []
         self.fill_value = 255
         self.rng = np.random.RandomState(self.kRandMagic)
         self.meanimg: Optional[np.ndarray] = None
+        self._seed_base = self.kRandMagic
+        self.nthread = min(8, os.cpu_count() or 4)
+        self._pool = None
+        self._buf: List[DataInst] = []
+        self._bufpos = 0
+        self._chunk = 64
 
     def set_param(self, name: str, val: str) -> None:
         self.base.set_param(name, val)
@@ -59,6 +72,9 @@ class AugmentAdapter(IIterator):
             self.shape = shape_from_conf(val)
         if name == "seed_data":
             self.rng = np.random.RandomState(self.kRandMagic + int(val))
+            self._seed_base = self.kRandMagic + int(val)
+        if name == "augment_nthread":
+            self.nthread = int(val)
         if name == "rand_crop":
             self.rand_crop = int(val)
         if name == "crop_y_start":
@@ -86,10 +102,26 @@ class AugmentAdapter(IIterator):
             self.max_rotate_angle = float(val)
         if name == "max_shear_ratio":
             self.max_shear_ratio = float(val)
+        if name == "max_aspect_ratio":
+            self.max_aspect_ratio = float(val)
+        if name == "min_random_scale":
+            self.min_random_scale = float(val)
+        if name == "max_random_scale":
+            self.max_random_scale = float(val)
+        if name == "min_img_size":
+            self.min_img_size = float(val)
+        if name == "max_img_size":
+            self.max_img_size = float(val)
+        if name == "min_crop_size":
+            self.min_crop_size = int(val)
+        if name == "max_crop_size":
+            self.max_crop_size = int(val)
         if name == "rotate":
             self.rotate = int(val)
         if name == "rotate_list":
-            self.rotate_list = [int(t) for t in val.split()]
+            # reference parses comma-separated ints; accept spaces too
+            self.rotate_list = [int(t) for t in
+                                val.replace(",", " ").split()]
         if name == "fill_value":
             self.fill_value = int(val)
         if name == "silent":
@@ -124,45 +156,95 @@ class AugmentAdapter(IIterator):
 
     def before_first(self) -> None:
         self.base.before_first()
+        self._buf, self._bufpos = [], 0
 
     # -- transforms ------------------------------------------------------
 
-    def _affine(self, img: np.ndarray) -> np.ndarray:
-        if (self.max_rotate_angle == 0 and self.max_shear_ratio == 0
-                and self.rotate < 0 and not self.rotate_list):
+    def _inst_rng(self, index: int) -> np.random.RandomState:
+        """Per-instance RNG stream keyed by (seed, instance index):
+        deterministic regardless of decode/augment thread interleaving
+        (the serial rand_r of the reference cannot survive a parallel
+        pipeline)."""
+        return np.random.RandomState(
+            (self._seed_base * 2654435761 + index * 97 + 13) % (2**31))
+
+    def _need_affine(self) -> bool:
+        return (self.max_rotate_angle > 0 or self.max_shear_ratio > 0
+                or self.rotate >= 0 or bool(self.rotate_list)
+                or self.max_aspect_ratio > 0
+                or self.min_random_scale != 1.0
+                or self.max_random_scale != 1.0)
+
+    def _affine(self, img: np.ndarray,
+                rng: np.random.RandomState) -> np.ndarray:
+        """Combined rotate/shear/scale/aspect warp, reproducing the
+        reference's single-matrix parameterization (Process,
+        image_augmenter-inl.hpp:75-120): the canvas rescales to
+        scale*(w,h) clamped to [min_img_size, max_img_size], aspect
+        ratio reshapes the content by hs=2s/(1+r), ws=r*hs."""
+        if not self._need_affine():
             return img
         import cv2
         if self.rotate >= 0:
             angle = float(self.rotate)
         elif self.rotate_list:
             angle = float(self.rotate_list[
-                self.rng.randint(len(self.rotate_list))])
+                rng.randint(len(self.rotate_list))])
         else:
-            angle = self.rng.uniform(-self.max_rotate_angle,
-                                     self.max_rotate_angle)
-        shear = self.rng.uniform(-self.max_shear_ratio,
-                                 self.max_shear_ratio)
+            angle = rng.uniform(-self.max_rotate_angle,
+                                self.max_rotate_angle)
+        shear = rng.uniform(-self.max_shear_ratio,
+                            self.max_shear_ratio)
+        scale = rng.uniform(self.min_random_scale,
+                            self.max_random_scale)
+        ratio = 1.0 + rng.uniform(-self.max_aspect_ratio,
+                                  self.max_aspect_ratio)
+        hs = 2.0 * scale / (1.0 + ratio)
+        ws = ratio * hs
         h, w = img.shape[:2]
-        a = np.deg2rad(angle)
-        m = np.array([[np.cos(a), -np.sin(a) + shear, 0],
-                      [np.sin(a), np.cos(a), 0]], np.float32)
-        m[0, 2] = w / 2 - m[0, 0] * w / 2 - m[0, 1] * h / 2
-        m[1, 2] = h / 2 - m[1, 0] * w / 2 - m[1, 1] * h / 2
+        rad = np.deg2rad(angle)
+        a, b = np.cos(rad), np.sin(rad)
+        new_w = max(self.min_img_size, min(self.max_img_size, scale * w))
+        new_h = max(self.min_img_size, min(self.max_img_size, scale * h))
+        new_w, new_h = int(round(new_w)), int(round(new_h))
+        m = np.array([[hs * a - shear * b * ws, hs * b + shear * a * ws, 0],
+                      [-b * ws, a * ws, 0]], np.float32)
+        # center the warped content on the new canvas
+        m[0, 2] = (new_w - (m[0, 0] * w + m[0, 1] * h)) / 2.0
+        m[1, 2] = (new_h - (m[1, 0] * w + m[1, 1] * h)) / 2.0
         return cv2.warpAffine(
-            img, m, (w, h), flags=cv2.INTER_LINEAR,
+            img, m, (new_w, new_h), flags=cv2.INTER_LINEAR,
             borderMode=cv2.BORDER_CONSTANT,
-            borderValue=(self.fill_value,) * 3).astype(np.float32)
+            borderValue=(self.fill_value,) * 3)    # preserves dtype
 
-    def _crop(self, img: np.ndarray) -> np.ndarray:
+    def _crop(self, img: np.ndarray,
+              rng: np.random.RandomState) -> np.ndarray:
         _, ty, tx = self.shape
+        import_cv2 = None
+        if self.min_crop_size > 0 and self.max_crop_size > 0:
+            # random crop size in [min,max], then resize to the target
+            # (Inception-style scale augmentation; the reference parses
+            # these knobs in image_augmenter-inl.hpp:47-48)
+            import cv2 as import_cv2
+            h, w = img.shape[:2]
+            hi = min(self.max_crop_size, h, w)
+            lo = min(self.min_crop_size, hi)
+            c = int(rng.randint(lo, hi + 1))
+            ys = rng.randint(h - c + 1) if self.rand_crop \
+                else (h - c) // 2
+            xs = rng.randint(w - c + 1) if self.rand_crop \
+                else (w - c) // 2
+            patch = img[ys:ys + c, xs:xs + c]
+            return import_cv2.resize(patch, (tx, ty),
+                                     interpolation=import_cv2.INTER_LINEAR)
         h, w = img.shape[:2]
         if h < ty or w < tx:
             raise ValueError(
                 "augment: input %dx%d smaller than target crop %dx%d"
                 % (h, w, ty, tx))
         if self.rand_crop:
-            ys = self.rng.randint(h - ty + 1)
-            xs = self.rng.randint(w - tx + 1)
+            ys = rng.randint(h - ty + 1)
+            xs = rng.randint(w - tx + 1)
         elif self.crop_y_start >= 0 or self.crop_x_start >= 0:
             ys = max(self.crop_y_start, 0)
             xs = max(self.crop_x_start, 0)
@@ -170,35 +252,76 @@ class AugmentAdapter(IIterator):
             ys, xs = (h - ty) // 2, (w - tx) // 2
         return img[ys:ys + ty, xs:xs + tx]
 
-    def _transform(self, data: np.ndarray) -> np.ndarray:
+    def _is_float_work(self) -> bool:
+        """True when any knob forces float math (mean/scale/jitter);
+        otherwise uint8 input stays uint8 through crop/mirror/warp so
+        the batch ships to the device at 1/4 the bytes (device-side
+        normalization is the TPU-idiomatic input path)."""
+        return (self.scale != 1.0 or self.meanimg is not None
+                or self.mean_value is not None
+                or self.max_random_contrast > 0
+                or self.max_random_illumination > 0)
+
+    def _transform(self, data: np.ndarray,
+                   rng: np.random.RandomState) -> np.ndarray:
         if data.ndim != 3:
-            return data * self.scale       # flat input: scale only
-        img = self._affine(data)
-        img = self._crop(img)
-        if self.mirror or (self.rand_mirror and self.rng.randint(2)):
+            return np.asarray(data, np.float32) * self.scale
+        keep_u8 = data.dtype == np.uint8 and not self._is_float_work()
+        img = data if keep_u8 else np.asarray(data, np.float32)
+        img = self._affine(img, rng)
+        img = self._crop(img, rng)
+        if self.mirror or (self.rand_mirror and rng.randint(2)):
             img = img[:, ::-1]
+        if keep_u8:
+            return np.ascontiguousarray(img)
+        img = np.asarray(img, np.float32)
         if self.meanimg is not None and self.meanimg.shape == img.shape:
             img = img - self.meanimg
         elif self.mean_value is not None:
             img = img - self.mean_value
         if self.max_random_contrast > 0 or self.max_random_illumination > 0:
-            c = 1.0 + self.rng.uniform(-self.max_random_contrast,
-                                       self.max_random_contrast)
-            i = self.rng.uniform(-self.max_random_illumination,
-                                 self.max_random_illumination)
+            c = 1.0 + rng.uniform(-self.max_random_contrast,
+                                  self.max_random_contrast)
+            i = rng.uniform(-self.max_random_illumination,
+                            self.max_random_illumination)
             img = img * c + i
         return np.ascontiguousarray(img * self.scale, np.float32)
 
+    def _transform_inst(self, inst: DataInst) -> DataInst:
+        return DataInst(index=inst.index,
+                        data=self._transform(np.asarray(inst.data),
+                                             self._inst_rng(inst.index)),
+                        label=inst.label,
+                        extra_data=inst.extra_data)
+
     def next(self) -> bool:
-        if not self.base.next():
-            return False
-        inst = self.base.value()
-        self._out = DataInst(index=inst.index,
-                             data=self._transform(
-                                 np.asarray(inst.data, np.float32)),
-                             label=inst.label,
-                             extra_data=inst.extra_data)
+        # chunked parallel transform: the reference augments inside its
+        # OpenMP decode loop (iter_image_recordio-inl.hpp:214-250); here
+        # a pool warps a chunk at a time
+        while self._bufpos >= len(self._buf):
+            chunk = []
+            while len(chunk) < self._chunk and self.base.next():
+                chunk.append(self.base.value())
+            if not chunk:
+                return False
+            if self._pool is None and self.nthread > 1:
+                from concurrent.futures import ThreadPoolExecutor
+                self._pool = ThreadPoolExecutor(max_workers=self.nthread)
+            if self._pool is not None and len(chunk) > 1:
+                self._buf = list(self._pool.map(self._transform_inst,
+                                                chunk))
+            else:
+                self._buf = [self._transform_inst(i) for i in chunk]
+            self._bufpos = 0
+        self._out = self._buf[self._bufpos]
+        self._bufpos += 1
         return True
 
     def value(self) -> DataInst:
         return self._out
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        self.base.close()

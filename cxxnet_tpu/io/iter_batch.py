@@ -118,7 +118,15 @@ class BatchAdapter(IIterator):
 
 
 class PrefetchIterator(IIterator):
-    """Background-thread double buffering of a batch iterator."""
+    """Background-thread double buffering of a batch iterator.
+
+    Restart protocol: every queued item carries the epoch number it was
+    produced under; ``before_first`` bumps the target epoch, so a stale
+    batch the producer was already blocked on delivering (the classic
+    double-buffer reset race, utils/thread_buffer.h:150-201) is
+    discarded by the consumer instead of being served as the first batch
+    of the new epoch.
+    """
 
     def __init__(self, base: IIterator, capacity: int = 2):
         self.base = base
@@ -128,11 +136,20 @@ class PrefetchIterator(IIterator):
         self._out: Optional[DataBatch] = None
         self._restart = threading.Event()
         self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._epoch = 0                 # consumer's target epoch
+        self._transform = None          # e.g. device_put in-thread
 
     def set_param(self, name: str, val: str) -> None:
         self.base.set_param(name, val)
         if name == "prefetch_capacity":
             self.capacity = int(val)
+
+    def set_transform(self, fn) -> None:
+        """Apply fn to each batch in the producer thread — used to
+        overlap host->device transfer (jax.device_put) with device
+        compute, the TPU analogue of the reference's copy overlap."""
+        self._transform = fn
 
     def init(self) -> None:
         self.base.init()
@@ -140,21 +157,42 @@ class PrefetchIterator(IIterator):
         self._thread = threading.Thread(target=self._producer, daemon=True)
         self._thread.start()
 
+    def _put(self, item) -> bool:
+        """Bounded put that stays interruptible by restart/close."""
+        while not self._stop.is_set() and not self._restart.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _producer(self) -> None:
         while not self._stop.is_set():
             self._restart.wait()
+            if self._stop.is_set():
+                return
             self._restart.clear()
+            with self._lock:
+                epoch = self._epoch
             self.base.before_first()
             while not self._stop.is_set() and not self._restart.is_set():
                 if self.base.next():
-                    self._q.put(self.base.value())
+                    item = self.base.value()
+                    if self._transform is not None:
+                        item = self._transform(item)
+                    if not self._put((epoch, item)):
+                        break
                 else:
-                    self._q.put(None)       # epoch end sentinel
+                    self._put((epoch, None))    # epoch end sentinel
                     break
 
     def before_first(self) -> None:
-        # drain stale items, then signal a fresh epoch
         assert self._q is not None, "prefetch iterator: not initialized"
+        with self._lock:
+            self._epoch += 1
+        # draining is an optimization (epoch tags already protect
+        # correctness); it frees queue slots so the producer can move on
         while True:
             try:
                 self._q.get_nowait()
@@ -163,11 +201,15 @@ class PrefetchIterator(IIterator):
         self._restart.set()
 
     def next(self) -> bool:
-        item = self._q.get()
-        if item is None:
-            return False
-        self._out = item
-        return True
+        while True:
+            epoch, item = self._q.get()
+            with self._lock:
+                if epoch != self._epoch:
+                    continue            # stale batch from a prior epoch
+            if item is None:
+                return False
+            self._out = item
+            return True
 
     def value(self) -> DataBatch:
         return self._out
@@ -175,3 +217,6 @@ class PrefetchIterator(IIterator):
     def close(self) -> None:
         self._stop.set()
         self._restart.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+        self.base.close()

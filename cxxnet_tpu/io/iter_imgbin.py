@@ -159,3 +159,8 @@ class ImageBinIterator(IIterator):
 
     def value(self) -> DataInst:
         return self._out
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None

@@ -39,6 +39,7 @@ class ImageRecordIterator(IIterator):
         self.nthread = max(4, os.cpu_count() or 4)
         self.shuffle = 0
         self.seed = 0
+        self.decode_uint8 = 0
         self._label_map: Optional[Dict[int, np.ndarray]] = None
         self._readers: List = []
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -65,6 +66,10 @@ class ImageRecordIterator(IIterator):
             self.shuffle = int(val)
         if name == "seed_data":
             self.seed = int(val)
+        if name == "decode_uint8":
+            # keep pixels uint8 through the host pipeline; the device
+            # casts to compute dtype (4x less host->device traffic)
+            self.decode_uint8 = int(val)
 
     # -- init ------------------------------------------------------------
 
@@ -128,7 +133,9 @@ class ImageRecordIterator(IIterator):
                            cv2.IMREAD_COLOR)
         if img is None:
             return None
-        data = img[:, :, ::-1].astype(np.float32)     # BGR -> RGB
+        data = img[:, :, ::-1]                        # BGR -> RGB
+        if not self.decode_uint8:
+            data = data.astype(np.float32)
         if self._label_map is not None:
             lab = self._label_map.get(index)
             if lab is None:
@@ -167,3 +174,12 @@ class ImageRecordIterator(IIterator):
 
     def value(self) -> DataInst:
         return self._out
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        for r in self._readers:
+            if hasattr(r, "close"):
+                r.close()
+        self._readers = []

@@ -27,6 +27,7 @@ from .conv import (BatchNormLayer, ConvolutionLayer, InsanityPoolingLayer,
 from .loss import LossLayer, LpLossLayer, MultiLogisticLayer, SoftmaxLayer
 from .pairtest import PairTestLayer
 from .pallas_kernels import PallasFullConnectLayer
+from .torch_adapter import TorchLayer
 
 _FACTORY: Dict[str, Callable[..., Layer]] = {
     "fullc": lambda cfg, **kw: FullConnectLayer(cfg),
@@ -60,6 +61,9 @@ _FACTORY: Dict[str, Callable[..., Layer]] = {
     "prelu": lambda cfg, **kw: PReluLayer(cfg),
     "batch_norm": lambda cfg, **kw: BatchNormLayer(True, cfg),
     "batch_norm_no_ma": lambda cfg, **kw: BatchNormLayer(False, cfg),
+    # cross-framework oracle (the caffe adapter equivalent): a torch-
+    # backed fullc/conv for pairtest-conv-torch style in-net A/B checks
+    "torch": lambda cfg, **kw: TorchLayer(cfg),
 }
 
 # registered in the reference enum but rejected by its factory

@@ -207,6 +207,10 @@ class Layer:
 
     # -- compute ---------------------------------------------------------
 
+    #: layers that reduce over the batch dimension (batch norm) set this
+    #: so FuncNet passes them the padded-row mask as a keyword
+    needs_mask = False
+
     def forward(self, params: Dict[str, jnp.ndarray],
                 state: Dict[str, jnp.ndarray],
                 inputs: List[jnp.ndarray],

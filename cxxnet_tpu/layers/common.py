@@ -70,9 +70,10 @@ class FullConnectLayer(Layer):
         if bf16:
             x = x.astype(jnp.bfloat16)
             w = w.astype(jnp.bfloat16)
-        y = jnp.dot(x, w, preferred_element_type=jnp.float32)
+        y = jnp.dot(x, w,
+                    preferred_element_type=None if bf16 else jnp.float32)
         if self.param.no_bias == 0:
-            y = y + params["bias"]
+            y = y + params["bias"].astype(y.dtype)
         return [y], state
 
 
@@ -325,7 +326,8 @@ class DropoutLayer(Layer):
             return [x], state
         assert rng is not None, "dropout needs an rng in training"
         pkeep = 1.0 - self.threshold
-        mask = (jax.random.uniform(rng, x.shape) < pkeep) / pkeep
+        mask = (jax.random.uniform(rng, x.shape) < pkeep).astype(x.dtype) \
+            / x.dtype.type(pkeep)
         return [x * mask], state
 
 

@@ -11,10 +11,15 @@ TPU-native design notes:
   (pooling_layer-inl.hpp:119-123) are reproduced exactly by padding the
   base pad with zeros (mshadow ``pad()`` is a zero pad) and the ceil
   overhang with the reducer's identity.
-- batch norm replicates the reference's per-(sub)batch statistics and
-  running-average update (batch_norm_layer-inl.hpp:120-175); under data
-  parallelism stats remain per-shard like the reference's per-device
-  nets (see SURVEY.md §7 hard part 6).
+- batch norm follows the reference's batch-statistics and
+  running-average semantics (batch_norm_layer-inl.hpp:120-175) with one
+  deliberate improvement: moments are taken over the GLOBAL batch.
+  Under data parallelism GSPMD all-reduces the per-shard sums (sync BN)
+  so a dp run computes exactly what the same global batch computes on
+  one device — unlike the reference, where each device normalized by
+  its private sub-batch and dp subtly changed training (SURVEY.md §7
+  hard part 6).  Padded tail rows (num_batch_padd) are excluded from
+  the moments via the batch mask.
 """
 
 from __future__ import annotations
@@ -36,6 +41,18 @@ def _conv_out_dim(size: int, pad: int, k: int, stride: int) -> int:
 def _pool_out_dim(size: int, pad: int, k: int, stride: int) -> int:
     # pooling_layer-inl.hpp:119-123 (ceil mode, window start clamped)
     return min(size + 2 * pad - k + stride - 1, size + 2 * pad - 1) // stride + 1
+
+
+def _max_pool(x, kh, kw, stride):
+    """Max pooling via reduce_window; XLA's select-and-scatter backward
+    measured faster end-to-end than a hand-written offset-loop VJP on
+    this hardware, so autodiff is left in charge."""
+    return jax.lax.reduce_window(
+        x, -jnp.inf if x.dtype == jnp.float32 else x.dtype.type(-jnp.inf),
+        jax.lax.max,
+        window_dimensions=(1, kh, kw, 1),
+        window_strides=(1, stride, stride, 1),
+        padding="VALID")
 
 
 class ConvolutionLayer(Layer):
@@ -76,28 +93,68 @@ class ConvolutionLayer(Layer):
             out["bias"] = jnp.full((p.num_channel,), p.init_bias, jnp.float32)
         return out
 
+    def _space_to_depth_conv(self, x, w):
+        """Strided entry conv as a dense conv over depth blocks.
+
+        A stride-s conv with few input channels (AlexNet conv1: 11x11
+        s4 over RGB) wastes the MXU — 3 of 128 input lanes are live.
+        Rearranging s x s input blocks into depth (228^2 x 3 ->
+        57^2 x 48) and folding the kernel the same way yields an
+        equivalent stride-1 conv with ceil(k/s)^2 taps over s^2*C
+        channels, which XLA tiles efficiently. Numerically identical
+        modulo summation order.
+        """
+        p = self.param
+        s = p.stride
+        k, c, o = p.kernel_height, x.shape[-1], p.num_channel
+        kp = -(-k // s) * s                   # kernel padded to mult of s
+        b, h, wd = x.shape[0], x.shape[1], x.shape[2]
+        oy = (h - k) // s + 1
+        ox = (wd - k) // s + 1
+        h2 = (oy - 1) * s + kp
+        w2 = (ox - 1) * s + kp
+        x = jnp.pad(x, ((0, 0), (0, h2 - h), (0, w2 - wd), (0, 0)))
+        # NHWC space-to-depth(s)
+        x = x.reshape(b, h2 // s, s, w2 // s, s, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+            b, h2 // s, w2 // s, s * s * c)
+        # HWIO kernel: pad to (kp, kp), fold s x s taps into depth
+        w4 = jnp.pad(w, ((0, kp - k), (0, kp - k), (0, 0), (0, 0)))
+        w4 = w4.reshape(kp // s, s, kp // s, s, c, o)
+        w4 = w4.transpose(0, 2, 1, 3, 4, 5).reshape(
+            kp // s, kp // s, s * s * c, o)
+        return jax.lax.conv_general_dilated(
+            x, w4, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
     def forward(self, params, state, inputs, is_train, rng):
         p = self.param
         x = inputs[0]
         w = params["wmat"]
         bf16 = p.compute_dtype == "bfloat16"
         if bf16:
-            # both operands bf16, output bf16, upcast after: the conv
-            # VJP requires matching operand/cotangent dtypes (MXU still
-            # accumulates in f32 internally)
+            # both operands bf16, output bf16 (the conv VJP requires
+            # matching operand/cotangent dtypes; MXU still accumulates
+            # in f32 internally)
             x = x.astype(jnp.bfloat16)
             w = w.astype(jnp.bfloat16)
-        y = jax.lax.conv_general_dilated(
-            x, w,
-            window_strides=(p.stride, p.stride),
-            padding=[(p.pad_y, p.pad_y), (p.pad_x, p.pad_x)],
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=p.num_group,
-            preferred_element_type=None if bf16 else jnp.float32)
-        if bf16:
-            y = y.astype(jnp.float32)
+        if (p.stride > 1 and p.num_group == 1 and x.shape[-1] <= 8
+                and p.pad_y == 0 and p.pad_x == 0
+                and p.kernel_height == p.kernel_width):
+            y = self._space_to_depth_conv(x, w)
+        else:
+            y = jax.lax.conv_general_dilated(
+                x, w,
+                window_strides=(p.stride, p.stride),
+                padding=[(p.pad_y, p.pad_y), (p.pad_x, p.pad_x)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=p.num_group,
+                preferred_element_type=None if bf16 else jnp.float32)
+        # bf16 outputs stay bf16: activations ride low-precision through
+        # relu/pool/lrn to the loss (which upcasts) — per-layer
+        # f32 round-trips were a wall of convert fusions in the profile
         if p.no_bias == 0:
-            y = y + params["bias"]
+            y = y + params["bias"].astype(y.dtype)
         return [y], state
 
 
@@ -139,17 +196,20 @@ class PoolingLayer(Layer):
         ey = max(0, need_y - x.shape[1])
         ex = max(0, need_x - x.shape[2])
         if self.mode == "max":
-            init, op = -jnp.inf, jax.lax.max
+            init = -jnp.inf
         else:
-            init, op = 0.0, jax.lax.add
+            init = 0.0
         if ey or ex:
             x = jnp.pad(x, ((0, 0), (0, ey), (0, ex), (0, 0)),
                         constant_values=init)
-        y = jax.lax.reduce_window(
-            x, init, op,
-            window_dimensions=(1, p.kernel_height, p.kernel_width, 1),
-            window_strides=(1, p.stride, p.stride, 1),
-            padding="VALID")
+        if self.mode == "max":
+            y = _max_pool(x, p.kernel_height, p.kernel_width, p.stride)
+        else:
+            y = jax.lax.reduce_window(
+                x, 0.0, jax.lax.add,
+                window_dimensions=(1, p.kernel_height, p.kernel_width, 1),
+                window_strides=(1, p.stride, p.stride, 1),
+                padding="VALID")
         if self.mode == "avg":
             y = y * (1.0 / (p.kernel_height * p.kernel_width))
         return y
@@ -240,15 +300,27 @@ class LRNLayer(Layer):
         h = self.nsize // 2
         # mshadow chpool window is [c-h, c+h] inclusive, clipped — a
         # size-(2h+1) window sum over the channel (last NHWC) axis.
+        # Summing 2h+1 shifted slices lets XLA fuse the whole normalizer
+        # into elementwise ops with an equally cheap VJP; reduce_window's
+        # select-scatter backward was ~16% of the AlexNet step time.
         win = 2 * h + 1
+        if self.param.compute_dtype == "bfloat16":
+            sq = sq.astype(jnp.bfloat16)
         pad = jnp.pad(sq, ((0, 0),) * (x.ndim - 1) + ((h, h),))
-        norm = jax.lax.reduce_window(
-            pad, 0.0, jax.lax.add,
-            window_dimensions=(1,) * (x.ndim - 1) + (win,),
-            window_strides=(1,) * x.ndim,
-            padding="VALID")
-        norm = norm * (self.alpha / self.nsize) + self.knorm
-        return [x * jnp.power(norm, -self.beta)], state
+        c = x.shape[-1]
+        norm = pad[..., 0:c]
+        for i in range(1, win):
+            norm = norm + pad[..., i:i + c]
+        norm = norm.astype(jnp.float32) * (self.alpha / self.nsize) \
+            + self.knorm
+        if self.beta == 0.75:
+            # norm^-0.75 = rsqrt(norm) * rsqrt(sqrt(norm)): two fast VPU
+            # rsqrts instead of a transcendental pow
+            r = jax.lax.rsqrt(norm)
+            scale = r * jax.lax.rsqrt(jnp.sqrt(norm))
+        else:
+            scale = jnp.power(norm, -self.beta)
+        return [x * scale.astype(x.dtype)], state
 
 
 class BatchNormLayer(Layer):
@@ -262,7 +334,13 @@ class BatchNormLayer(Layer):
     Normalization axis follows the reference's fc/conv detection: conv
     nodes normalize per channel over (batch, y, x); matrix nodes per
     feature over batch. eps default 1e-10, running-average momentum 0.9.
+
+    Moments are over the global batch — sync BN under data parallelism
+    (a deliberate improvement over the reference's per-device stats; see
+    module docstring) — and exclude padded tail rows via the mask.
     """
+
+    needs_mask = True
 
     def __init__(self, moving_avg: bool, cfg=()):
         self.moving_avg = moving_avg
@@ -306,19 +384,28 @@ class BatchNormLayer(Layer):
             "running_var": jnp.zeros((self.channel,), jnp.float32),
         }
 
-    def _moments(self, x: jnp.ndarray):
+    def _moments(self, x: jnp.ndarray, mask: Optional[jnp.ndarray]):
+        x = x.astype(jnp.float32)           # stable stats in bf16 nets
         axes = tuple(range(x.ndim - 1))     # all but channel/feature
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.mean(jnp.square(x - mean), axis=axes)
+        if mask is None:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.mean(jnp.square(x - mean), axis=axes)
+            return mean, var
+        # weight rows by the padded-tail mask: (batch,) -> (batch,1[,1,1])
+        w = mask.reshape((-1,) + (1,) * (x.ndim - 1))
+        n = jnp.sum(mask) * (x.size // (x.shape[0] * x.shape[-1]))
+        n = jnp.maximum(n, 1.0)
+        mean = jnp.sum(x * w, axis=axes) / n
+        var = jnp.sum(jnp.square(x - mean) * w, axis=axes) / n
         return mean, var
 
-    def forward(self, params, state, inputs, is_train, rng):
+    def forward(self, params, state, inputs, is_train, rng, mask=None):
         x = inputs[0]
         slope, bias = params["wmat"], params["bias"]
         if is_train:
-            mean, var = self._moments(x)
+            mean, var = self._moments(x, mask)
             xhat = (x - mean) * jax.lax.rsqrt(var + self.eps)
-            out = xhat * slope + bias
+            out = (xhat * slope + bias).astype(x.dtype)
             if self.moving_avg:
                 m = self.bn_momentum
                 state = dict(
@@ -329,6 +416,6 @@ class BatchNormLayer(Layer):
         if self.moving_avg:
             mean, var = state["running_exp"], state["running_var"]
         else:
-            mean, var = self._moments(x)
+            mean, var = self._moments(x, mask)
         scale = slope * jax.lax.rsqrt(var + self.eps)
-        return [x * scale + (bias - mean * scale)], state
+        return [(x * scale + (bias - mean * scale)).astype(x.dtype)], state

@@ -63,14 +63,19 @@ class LossLayer(Layer):
 
 
 class SoftmaxLayer(LossLayer):
-    """Softmax + cross-entropy on an integer class label (1 column)."""
+    """Softmax + cross-entropy on an integer class label (1 column).
+
+    Logits are upcast to f32 at this boundary: in mixed-precision nets
+    the activations ride bf16 and the loss is where precision returns.
+    """
 
     def forward(self, params, state, inputs, is_train, rng):
-        return [jax.nn.softmax(inputs[0], axis=-1)], state
+        return [jax.nn.softmax(inputs[0].astype(jnp.float32),
+                               axis=-1)], state
 
     def loss_value(self, logit, label, mask):
         lab = label[:, 0].astype(jnp.int32)
-        logp = jax.nn.log_softmax(logit, axis=-1)
+        logp = jax.nn.log_softmax(logit.astype(jnp.float32), axis=-1)
         ce = -jnp.take_along_axis(logp, lab[:, None], axis=-1)[:, 0]
         return self._scale() * jnp.sum(ce * mask)
 
@@ -92,10 +97,10 @@ class LpLossLayer(LossLayer):
             self.p = float(val)
 
     def forward(self, params, state, inputs, is_train, rng):
-        return [inputs[0]], state
+        return [inputs[0].astype(jnp.float32)], state
 
     def loss_value(self, logit, label, mask):
-        d = jnp.abs(logit - label)
+        d = jnp.abs(logit.astype(jnp.float32) - label)
         if self.p == 2.0:
             lp = d * d
         elif self.p == 1.0:
@@ -113,9 +118,10 @@ class MultiLogisticLayer(LossLayer):
     """
 
     def forward(self, params, state, inputs, is_train, rng):
-        return [jax.nn.sigmoid(inputs[0])], state
+        return [jax.nn.sigmoid(inputs[0].astype(jnp.float32))], state
 
     def loss_value(self, logit, label, mask):
+        logit = logit.astype(jnp.float32)
         # numerically stable BCE-with-logits
         bce = jnp.maximum(logit, 0) - logit * label \
             + jnp.log1p(jnp.exp(-jnp.abs(logit)))

@@ -87,13 +87,22 @@ class PairTestLayer(Layer):
              if k.startswith("slave:")}
         return m, s
 
-    def forward(self, params, state, inputs, is_train, rng):
+    @property
+    def needs_mask(self):
+        return self.master.needs_mask or self.slave.needs_mask
+
+    def forward(self, params, state, inputs, is_train, rng, mask=None):
         mp, sp = self._split(params)
         ms, ss = self._split(state)
-        mouts, ms2 = self.master.forward(mp, ms, list(inputs),
-                                         is_train, rng)
-        souts, ss2 = self.slave.forward(sp, ss, list(inputs),
-                                        is_train, rng)
+
+        def run(layer, p, s):
+            if layer.needs_mask:
+                return layer.forward(p, s, list(inputs), is_train, rng,
+                                     mask=mask)
+            return layer.forward(p, s, list(inputs), is_train, rng)
+
+        mouts, ms2 = run(self.master, mp, ms)
+        souts, ss2 = run(self.slave, sp, ss)
         diff = jnp.float32(0.0)
         outs = []
         for m, s in zip(mouts, souts):

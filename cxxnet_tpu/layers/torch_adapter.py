@@ -1,0 +1,207 @@
+"""Foreign-framework oracle layer: torch inside the net.
+
+The reference embeds Caffe layers in-net for cross-framework A/B
+validation (``/root/reference/src/plugin/caffe_adapter-inl.hpp:27-231``,
+enabled via CXXNET_USE_CAFFE_ADAPTOR) — the third corner of its
+validation triangle: hand kernel vs library vs foreign framework.  Here
+the foreign framework is torch (CPU), embedded the TPU-native way:
+
+- forward runs through ``jax.pure_callback`` (a host call inside the
+  jitted program — shapes are static, so XLA treats it as an opaque op);
+- backward is a ``jax.custom_vjp`` whose bwd rule calls torch autograd
+  on the host, so ``jax.grad`` through a torch layer yields torch's
+  gradients.
+
+Config type ``torch``: infers the op from the same keys the native
+layers use (``nhidden`` -> linear, ``nchannel``/``kernel_size`` ->
+conv2d), so ``pairtest-conv-torch`` / ``pairtest-fullc-torch`` need no
+extra parameters and share one weight init with the master.  Parameter
+layouts match the native layers exactly (fullc wmat (in,out); conv wmat
+HWIO); conversion to torch's (out,in) / OIHW happens inside the
+callback.
+
+This is a validation oracle, not a production path: the callback
+round-trips device->host per call and is deliberately unsharded.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import Layer, Shape3
+from .conv import _conv_out_dim
+
+
+def _torch():
+    import torch
+    return torch
+
+
+# ---------------------------------------------------------------------------
+# host-side compute (numpy in / numpy out)
+
+def _host_forward(op, stride, pad, groups, x, w, b):
+    torch = _torch()
+    with torch.no_grad():
+        # copies keep torch off jax's read-only callback buffers
+        tx = torch.from_numpy(np.array(x, copy=True))
+        tw = torch.from_numpy(np.array(w, copy=True))
+        tb = torch.from_numpy(np.array(b, copy=True)) \
+            if b is not None else None
+        if op == "fullc":
+            y = torch.nn.functional.linear(tx, tw.t(), tb)
+        else:
+            # NHWC -> NCHW, HWIO -> OIHW
+            y = torch.nn.functional.conv2d(
+                tx.permute(0, 3, 1, 2),
+                tw.permute(3, 2, 0, 1), tb,
+                stride=stride, padding=pad, groups=groups)
+            y = y.permute(0, 2, 3, 1).contiguous()
+        return y.numpy().astype(np.float32)
+
+
+def _host_backward(op, stride, pad, groups, has_bias, x, w, b, gy):
+    torch = _torch()
+    tx = torch.from_numpy(np.array(x, copy=True)).requires_grad_(True)
+    tw = torch.from_numpy(np.array(w, copy=True)).requires_grad_(True)
+    tb = torch.from_numpy(np.array(b, copy=True)).requires_grad_(True) \
+        if has_bias else None
+    if op == "fullc":
+        y = torch.nn.functional.linear(tx, tw.t(), tb)
+        gy_t = torch.from_numpy(np.array(gy, copy=True))
+    else:
+        y = torch.nn.functional.conv2d(
+            tx.permute(0, 3, 1, 2), tw.permute(3, 2, 0, 1), tb,
+            stride=stride, padding=pad, groups=groups)
+        gy_t = torch.from_numpy(
+            np.array(gy, copy=True)).permute(0, 3, 1, 2)
+    y.backward(gy_t)
+    gx = tx.grad.numpy().astype(np.float32)
+    gw = tw.grad.numpy().astype(np.float32)
+    if has_bias:
+        return gx, gw, tb.grad.numpy().astype(np.float32)
+    return gx, gw
+
+
+# ---------------------------------------------------------------------------
+# jax-side wrappers (custom_vjp around pure_callback)
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _torch_apply(op, stride, pad, groups, out_shape, x, w, b):
+    return jax.pure_callback(
+        partial(_host_forward, op, stride, pad, groups),
+        jax.ShapeDtypeStruct(out_shape, jnp.float32), x, w, b,
+        vmap_method="sequential")
+
+
+def _torch_apply_fwd(op, stride, pad, groups, out_shape, x, w, b):
+    y = _torch_apply(op, stride, pad, groups, out_shape, x, w, b)
+    return y, (x, w, b)
+
+
+def _torch_apply_bwd(op, stride, pad, groups, out_shape, res, gy):
+    x, w, b = res
+    has_bias = b is not None
+    shapes = [jax.ShapeDtypeStruct(x.shape, jnp.float32),
+              jax.ShapeDtypeStruct(w.shape, jnp.float32)]
+    if has_bias:
+        shapes.append(jax.ShapeDtypeStruct(b.shape, jnp.float32))
+    grads = jax.pure_callback(
+        partial(_host_backward, op, stride, pad, groups, has_bias),
+        tuple(shapes), x, w, b if has_bias else jnp.zeros((0,)), gy,
+        vmap_method="sequential")
+    if has_bias:
+        return tuple(grads)
+    return grads[0], grads[1], None
+
+
+_torch_apply.defvjp(_torch_apply_fwd, _torch_apply_bwd)
+
+
+# ---------------------------------------------------------------------------
+
+class TorchLayer(Layer):
+    """The 'torch' config layer: torch-backed fullc or conv."""
+
+    def __init__(self, cfg=()):
+        self.op = ""            # "" = infer from config keys
+        super().__init__(cfg)
+
+    def set_param(self, name, val):
+        super().set_param(name, val)
+        if name == "op":
+            self.op = val
+
+    def _resolve_op(self) -> str:
+        if self.op:
+            return self.op
+        if self.param.num_channel > 0:
+            return "conv"
+        if self.param.num_hidden > 0:
+            return "fullc"
+        raise ValueError(
+            "torch layer: set nhidden (linear) or nchannel (conv)")
+
+    def infer_shape(self, in_shapes: List[Shape3]) -> List[Shape3]:
+        s = self._expect_one(in_shapes)
+        p = self.param
+        op = self._resolve_op()
+        self.in_shapes = [s]
+        if op == "fullc":
+            if not s.is_mat:
+                raise ValueError("torch fullc: input must be a matrix")
+            if p.num_input_node == 0:
+                p.num_input_node = s.x
+            self.out_shapes = [Shape3(1, 1, p.num_hidden)]
+        else:
+            if p.pad_y != p.pad_x:
+                raise ValueError("torch conv: asymmetric pad unsupported")
+            if p.num_input_channel == 0:
+                p.num_input_channel = s.ch
+            oy = _conv_out_dim(s.y, p.pad_y, p.kernel_height, p.stride)
+            ox = _conv_out_dim(s.x, p.pad_x, p.kernel_width, p.stride)
+            self.out_shapes = [Shape3(p.num_channel, oy, ox)]
+        return self.out_shapes
+
+    def init_params(self, key: jax.Array) -> Dict[str, jnp.ndarray]:
+        # identical layouts + init path as the native layers, so a
+        # pairtest master/slave pair starts from the same weights
+        p = self.param
+        if self._resolve_op() == "fullc":
+            k1, _ = jax.random.split(key)
+            wmat = p.rand_init_weight(
+                k1, (p.num_input_node, p.num_hidden),
+                p.num_input_node, p.num_hidden)
+            out = {"wmat": wmat}
+            if p.no_bias == 0:
+                out["bias"] = jnp.full((p.num_hidden,), p.init_bias,
+                                       jnp.float32)
+            return out
+        in_pg = p.num_input_channel // p.num_group
+        shape = (p.kernel_height, p.kernel_width, in_pg, p.num_channel)
+        fan_in = in_pg * p.kernel_height * p.kernel_width
+        fan_out = p.num_channel // p.num_group
+        out = {"wmat": p.rand_init_weight(key, shape, fan_in, fan_out)}
+        if p.no_bias == 0:
+            out["bias"] = jnp.full((p.num_channel,), p.init_bias,
+                                   jnp.float32)
+        return out
+
+    def forward(self, params, state, inputs, is_train, rng):
+        p = self.param
+        op = self._resolve_op()
+        x = inputs[0]
+        b = params.get("bias")
+        out3 = self.out_shapes[0]
+        if op == "fullc":
+            out_shape = (x.shape[0], out3.x)
+        else:
+            out_shape = (x.shape[0], out3.y, out3.x, out3.ch)
+        y = _torch_apply(op, p.stride, p.pad_y, p.num_group,
+                         out_shape, x, params["wmat"], b)
+        return [y], state

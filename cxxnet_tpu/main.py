@@ -143,15 +143,17 @@ class LearnTask:
             if latest is not None:
                 self.model_in = latest
 
-        # iterators
+        # iterators (closed on exit: prefetch threads / decode pools)
         itr_train = None
         eval_iters: List[Tuple[str, object]] = []
         pred_iter = None
+        all_iters: List[object] = []
         batch_cfg = [(k, v) for k, v in global_cfg
                      if k in ("batch_size", "input_shape", "label_width")]
         for b in blocks:
             it = create_iterator(b["cfg"], batch_cfg)
             it.init()
+            all_iters.append(it)
             if b["kind"] == "data":
                 itr_train = it
             elif b["kind"] == "eval":
@@ -159,30 +161,34 @@ class LearnTask:
             elif b["kind"] == "pred":
                 pred_iter = it
 
-        if self.test_io:
-            return self._task_test_io(itr_train)
+        try:
+            if self.test_io:
+                return self._task_test_io(itr_train)
 
-        trainer = NetTrainer(cfg)
-        if self.task in ("train", "finetune"):
-            if self.model_in and self.task == "train":
-                trainer.load_model(self.model_in)
-            else:
-                trainer.init_model()
-                if self.task == "finetune":
-                    assert self.model_in, "finetune requires model_in"
-                    trainer.copy_model_from(self.model_in)
-            return self._task_train(trainer, itr_train, eval_iters)
+            trainer = NetTrainer(cfg)
+            if self.task in ("train", "finetune"):
+                if self.model_in and self.task == "train":
+                    trainer.load_model(self.model_in)
+                else:
+                    trainer.init_model()
+                    if self.task == "finetune":
+                        assert self.model_in, "finetune requires model_in"
+                        trainer.copy_model_from(self.model_in)
+                return self._task_train(trainer, itr_train, eval_iters)
 
-        assert self.model_in, "task %s requires model_in" % self.task
-        trainer.load_model(self.model_in)
-        if self.task == "pred":
-            return self._task_predict(trainer, pred_iter or itr_train)
-        if self.task == "extract_feature":
-            return self._task_extract(trainer, pred_iter or itr_train)
-        if self.task == "get_weight":
-            return self._task_get_weight(trainer)
-        print("unknown task %r" % self.task)
-        return 1
+            assert self.model_in, "task %s requires model_in" % self.task
+            trainer.load_model(self.model_in)
+            if self.task == "pred":
+                return self._task_predict(trainer, pred_iter or itr_train)
+            if self.task == "extract_feature":
+                return self._task_extract(trainer, pred_iter or itr_train)
+            if self.task == "get_weight":
+                return self._task_get_weight(trainer)
+            print("unknown task %r" % self.task)
+            return 1
+        finally:
+            for it in all_iters:
+                it.close()
 
     def _task_test_io(self, itr) -> int:
         assert itr is not None, "test_io requires a data block"
@@ -198,6 +204,10 @@ class LearnTask:
 
     def _task_train(self, trainer, itr_train, eval_iters) -> int:
         assert itr_train is not None, "train requires a data block"
+        if hasattr(itr_train, "set_transform"):
+            # threadbuffer chains overlap host->device transfer with
+            # device compute by device_put-ing in the prefetch thread
+            itr_train.set_transform(trainer.device_put_batch)
         start = time.time()
         for r in range(self.start_counter - 1, self.num_round):
             trainer.start_round(r)

@@ -241,14 +241,17 @@ class NetTrainer:
             return new_p, new_o
 
         def train_step(params, opt_state, net_state, grad_acc,
-                       data, labels, mask, extra, hyper_arr, base_key,
-                       do_update):
-            step = hyper_arr[0, 4].astype(jnp.uint32)
+                       data, labels, mask, extra, hyper_arr, step,
+                       base_key, do_update):
+            # step rides as its own uint32 scalar — packing it into the
+            # float32 hyper array silently rounded past 2^24 steps,
+            # repeating dropout/insanity RNG streams on long runs
             rng = jax.random.fold_in(base_key, step)
             (loss, (new_state, preds)), grads = jax.value_and_grad(
                 net.loss_fn, has_aux=True)(
                     params, net_state, data, labels, mask, extra=extra,
                     rng=rng, collect_nodes=metric_nodes)
+            preds = [p.astype(jnp.float32) for p in preds]
             if update_period == 1:
                 params, opt_state = apply_updates(
                     params, opt_state, grads, hyper_arr)
@@ -280,20 +283,19 @@ class NetTrainer:
                                    out_shardings=out_shardings)
 
         def multi_step(params, opt_state, net_state, data, labels, mask,
-                       extra, hyper_arr, base_key, n_steps):
+                       extra, hyper_arr, step, base_key, n_steps):
             """n_steps full update steps in ONE dispatch (lax.scan over
             the same resident batch) — host dispatch latency amortizes
             to zero; LR/epoch are frozen across the window."""
             def body(carry, i):
                 p, o, s = carry
-                h = hyper_arr.at[0, 4].add(i.astype(jnp.float32))
                 p, o, s, _, loss, _ = train_step(
-                    p, o, s, None, data, labels, mask, extra, h,
-                    base_key, do_update=True)
+                    p, o, s, None, data, labels, mask, extra, hyper_arr,
+                    step + i, base_key, do_update=True)
                 return (p, o, s), loss
             (params, opt_state, net_state), losses = jax.lax.scan(
                 body, (params, opt_state, net_state),
-                jnp.arange(n_steps))
+                jnp.arange(n_steps, dtype=jnp.uint32))
             return params, opt_state, net_state, losses[-1]
 
         self._multi_step = jax.jit(
@@ -302,11 +304,15 @@ class NetTrainer:
             out_shardings=(self._p_shard, self._o_shard, ns_shard,
                            self._repl))
 
-        def pred_step(params, net_state, data, extra, nodes_wanted):
+        def pred_step(params, net_state, data, mask, extra,
+                      nodes_wanted):
             node_vals, _, _ = net.forward(params, net_state, data,
                                           extra=extra,
-                                          is_train=False, rng=None)
-            return [node_vals[i] for i in nodes_wanted]
+                                          is_train=False, rng=None,
+                                          mask=mask)
+            # metrics/extraction read f32 regardless of compute dtype
+            return [node_vals[i].astype(jnp.float32)
+                    for i in nodes_wanted]
 
         self._pred_step = jax.jit(pred_step,
                                   static_argnames=("nodes_wanted",))
@@ -314,17 +320,21 @@ class NetTrainer:
     # -- hyper-params per step ------------------------------------------
 
     def _hyper(self) -> np.ndarray:
-        """Packed (n_updaters, 5) array: lr, momentum, wd, epoch, step."""
+        """Packed (n_updaters, 4) array: lr, momentum, wd, epoch."""
         epoch = self.update_counter
-        arr = np.zeros((len(self._hyper_index), 5), np.float32)
+        arr = np.zeros((len(self._hyper_index), 4), np.float32)
         for i, (lk, tag) in enumerate(self._hyper_index):
             upd = self.updaters[lk][tag]
             upd.param.schedule_epoch(epoch)
             arr[i] = (upd.param.learning_rate, upd.param.momentum,
-                      upd.param.wd, epoch, 0.0)
-        arr[0, 4] = self.update_counter * self.update_period \
-            + self.sample_counter
+                      upd.param.wd, epoch)
         return arr
+
+    def _step_scalar(self) -> np.uint32:
+        """Global sample-step counter for RNG folding (exact uint32; a
+        float32 slot loses integer precision past 2^24)."""
+        return np.uint32(self.update_counter * self.update_period
+                         + self.sample_counter)
 
     # -- batch plumbing --------------------------------------------------
 
@@ -341,13 +351,28 @@ class NetTrainer:
     def _put_batch_array(self, x) -> jnp.ndarray:
         if isinstance(x, jax.Array) and x.sharding == self._b_shard:
             return x                      # already resident (test_skipread)
-        return jax.device_put(np.asarray(x, np.float32), self._b_shard)
+        arr = np.asarray(x)
+        if arr.dtype != np.uint8:         # u8 pixels ship raw (1/4 bytes)
+            arr = np.asarray(arr, np.float32)
+        return jax.device_put(arr, self._b_shard)
 
     def _device_batch(self, batch: DataBatch):
         data = self._put_batch_array(batch.data)
         labels = self._put_batch_array(batch.label)
         mask = self._put_batch_array(self._mask(batch))
         return data, labels, mask, self._device_extra(batch)
+
+    def device_put_batch(self, batch: DataBatch) -> DataBatch:
+        """Move a batch's arrays to the device with the batch sharding.
+        Hand this to PrefetchIterator.set_transform so the transfer
+        happens in the prefetch thread, overlapped with compute."""
+        return DataBatch(
+            data=self._put_batch_array(batch.data),
+            label=self._put_batch_array(batch.label),
+            inst_index=batch.inst_index,
+            num_batch_padd=batch.num_batch_padd,
+            extra_data=[self._put_batch_array(e)
+                        for e in batch.extra_data])
 
     def _device_extra(self, batch: DataBatch):
         return tuple(self._put_batch_array(e) for e in batch.extra_data)
@@ -366,7 +391,7 @@ class NetTrainer:
         out = self._train_step(self.params, self.opt_state,
                                self.net_state, self.grad_acc,
                                data, labels, mask, extra, hyper,
-                               self._base_key,
+                               self._step_scalar(), self._base_key,
                                do_update=bool(do_update))
         (self.params, self.opt_state, self.net_state,
          self.grad_acc, loss, preds) = out
@@ -389,7 +414,8 @@ class NetTrainer:
         data, labels, mask, extra = self._device_batch(batch)
         out = self._multi_step(self.params, self.opt_state,
                                self.net_state, data, labels, mask,
-                               extra, self._hyper(), self._base_key,
+                               extra, self._hyper(),
+                               self._step_scalar(), self._base_key,
                                n_steps=int(n_steps))
         (self.params, self.opt_state, self.net_state, loss) = out
         self._last_loss = loss
@@ -410,6 +436,8 @@ class NetTrainer:
             data = jax.device_put(np.asarray(batch.data, np.float32),
                                   self._b_shard)
             vals = self._pred_step(self.params, self.net_state, data,
+                                   self._put_batch_array(
+                                       self._mask(batch)),
                                    self._device_extra(batch),
                                    nodes_wanted=nodes_wanted)
             nvalid = batch.batch_size - batch.num_batch_padd
@@ -426,6 +454,8 @@ class NetTrainer:
         data = jax.device_put(np.asarray(batch.data, np.float32),
                               self._b_shard)
         (val,) = self._pred_step(self.params, self.net_state, data,
+                                 self._put_batch_array(
+                                     self._mask(batch)),
                                  self._device_extra(batch),
                                  nodes_wanted=(top,))
         m = np.asarray(as_mat(val))
@@ -440,6 +470,8 @@ class NetTrainer:
         data = jax.device_put(np.asarray(batch.data, np.float32),
                               self._b_shard)
         (val,) = self._pred_step(self.params, self.net_state, data,
+                                 self._put_batch_array(
+                                     self._mask(batch)),
                                  self._device_extra(batch),
                                  nodes_wanted=(ni,))
         nvalid = batch.batch_size - batch.num_batch_padd

@@ -1,0 +1,103 @@
+"""Per-layer AlexNet step profile on the current backend.
+
+Times each layer's forward (and its VJP) in isolation at the benchmark
+shapes, plus the full step, to locate where the time goes — the written
+profile doc/debug_perf.md promises (reference doc/debug_perf.md:3-21).
+
+Usage: python doc/profile_alexnet.py [batch] > profile.txt
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(f, *args, iters=30):
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3      # ms
+
+
+def main(batch=256, dtype=jnp.bfloat16):
+    from cxxnet_tpu.graph import NetGraph
+    from cxxnet_tpu.models import alexnet
+    from cxxnet_tpu.nnet.net import FuncNet
+    from cxxnet_tpu.utils.config import parse_config
+
+    g = NetGraph()
+    g.configure(parse_config(alexnet(nclass=1000, batch_size=batch,
+                                     image_size=227))
+                + [("dtype", "bfloat16")])
+    net = FuncNet(g, batch)
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+
+    print("== per-layer forward+backward (batch %d, bf16) ==" % batch)
+    node_vals = {}
+    x = jnp.asarray(rng.rand(batch, 227, 227, 3), jnp.float32)
+    nodes, _, _ = net.forward(params, state, x, is_train=False)
+    total_est = 0.0
+    rows = []
+    for li, info in enumerate(g.layers):
+        layer = net.layer_objs[li]
+        lkey = g.layer_key(g.param_layer_index(li))
+        p = params.get(lkey, {})
+        s = state.get(lkey, {})
+        ins = [nodes[ni] for ni in info.nindex_in]
+        key = jax.random.PRNGKey(1)
+
+        def fwd(p, ins, s=s, layer=layer, key=key):
+            outs, _ = layer.forward(p, s, ins, True, key) \
+                if not layer.needs_mask else \
+                layer.forward(p, s, ins, True, key, mask=None)
+            return sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+
+        grad_fn = jax.jit(jax.grad(fwd, argnums=(0, 1)))
+        fwd_fn = jax.jit(fwd)
+        try:
+            tf = timeit(fwd_fn, p, ins)
+            tg = timeit(grad_fn, p, ins)
+        except Exception as e:
+            print("%-22s SKIP (%s)" % (info.name or info.type, e))
+            continue
+        rows.append((info.name or info.type, tf, tg))
+        total_est += tg
+    for name, tf, tg in sorted(rows, key=lambda r: -r[2]):
+        print("%-22s fwd %7.3f ms   fwd+bwd %7.3f ms  (%4.1f%%)"
+              % (name, tf, tg, 100 * tg / total_est))
+    print("sum of isolated fwd+bwd: %.1f ms" % total_est)
+
+    # full jitted training step for comparison
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    t = NetTrainer(parse_config(alexnet(nclass=1000, batch_size=batch,
+                                        image_size=227))
+                   + [("eval_train", "0"), ("dtype", "bfloat16")])
+    t.init_model()
+    b = DataBatch(data=rng.rand(batch, 227, 227, 3).astype(np.float32),
+                  label=rng.randint(0, 1000, (batch, 1)).astype(
+                      np.float32))
+    t.update(b)
+    steps = 30
+    t.run_steps(b, steps)
+    _ = t.last_loss
+    t0 = time.perf_counter()
+    t.run_steps(b, steps)
+    _ = t.last_loss
+    dt = (time.perf_counter() - t0) / steps * 1e3
+    print("full train step: %.2f ms  -> %.0f img/s" % (dt, batch / dt * 1e3))
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 256)

@@ -1,0 +1,63 @@
+"""MNIST through the Python wrapper — the wrapper integration demo
+(reference example/MNIST/mnist.py uses wrapper/cxxnet.py the same way).
+
+Run: python mnist.py   (from example/MNIST; fetches/synthesizes data)
+"""
+
+import os
+import sys
+
+sys.path.append(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "..", ".."))
+
+from get_data import ensure_data  # noqa: E402
+from cxxnet_tpu import wrapper as cxxnet  # noqa: E402
+
+data_dir = ensure_data()
+
+data = cxxnet.DataIter("""
+iter = mnist
+    path_img = "%(d)s/train-images-idx3-ubyte"
+    path_label = "%(d)s/train-labels-idx1-ubyte"
+    shuffle = 1
+iter = end
+input_shape = 1,1,784
+batch_size = 100
+""" % {"d": data_dir})
+print("init data iter")
+
+deval = cxxnet.DataIter("""
+iter = mnist
+    path_img = "%(d)s/t10k-images-idx3-ubyte"
+    path_label = "%(d)s/t10k-labels-idx1-ubyte"
+iter = end
+input_shape = 1,1,784
+batch_size = 100
+""" % {"d": data_dir})
+print("init eval iter")
+
+cfg = """
+netconfig=start
+layer[+1] = fullc:fc1
+  nhidden = 160
+  init_sigma = 0.01
+layer[+1] = relu:ac1
+layer[+1] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.01
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,784
+batch_size = 100
+"""
+
+param = {
+    "eta": 0.1,
+    "momentum": 0.9,
+    "wd": 0.0,
+    "metric": "error",
+}
+
+net = cxxnet.train(cfg, data, 10, param, eval_data=deval)
+print("done")

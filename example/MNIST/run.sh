@@ -1,0 +1,14 @@
+#!/bin/bash
+# Train the MNIST example: ./run.sh MNIST.conf  (or MNIST_CONV.conf)
+# Downloads real MNIST when the network allows; otherwise synthesizes a
+# drop-in idx dataset from sklearn's bundled handwritten digits.
+set -e
+cd "$(dirname "$0")"
+
+python get_data.py
+
+mkdir -p models
+
+REPO="$(cd ../.. && pwd)"
+PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m cxxnet_tpu.main "${1:-MNIST.conf}" "${@:2}"

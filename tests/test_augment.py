@@ -1,0 +1,168 @@
+"""Statistical pins for every image_augmenter knob
+(reference image_augmenter-inl.hpp:13-222): each knob measurably changes
+the output distribution in its documented direction.
+"""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataInst, IIterator
+from cxxnet_tpu.io.iter_augment import AugmentAdapter
+
+pytest.importorskip("cv2")
+
+
+class Repeat(IIterator):
+    """Serves the same image n times."""
+
+    def __init__(self, img, n=200):
+        self.img, self.n = img, n
+
+    def init(self):
+        self.i = 0
+
+    def before_first(self):
+        self.i = 0
+
+    def next(self):
+        if self.i >= self.n:
+            return False
+        self.i += 1
+        self._v = DataInst(index=self.i, data=self.img.copy(),
+                           label=np.asarray([0.0]))
+        return True
+
+    def value(self):
+        return self._v
+
+
+def _img(size=24):
+    """A bright off-center rectangle on dark ground — asymmetric under
+    mirror and rotation so every warp is detectable."""
+    img = np.zeros((size, size, 3), np.float32)
+    img[size // 4: size // 2, size // 8: size // 2] = 200.0
+    return img
+
+
+def _collect(params, n=200, size=24, target=16):
+    aug = AugmentAdapter(Repeat(_img(size), n))
+    aug.set_param("input_shape", "3,%d,%d" % (target, target))
+    aug.set_param("fill_value", "0")
+    for k, v in params:
+        aug.set_param(k, v)
+    aug.init()
+    out = [inst.data for inst in aug]
+    assert len(out) == n
+    for o in out:
+        assert o.shape == (target, target, 3)
+    return np.stack(out)
+
+
+def test_rotate_fixed_angle_deterministic():
+    a = _collect([("rotate", "90")])
+    b = _collect([("rotate", "90")])
+    np.testing.assert_allclose(a, b)
+    c = _collect([("rotate", "0")])
+    assert np.abs(a - c).max() > 1.0     # 90 deg actually rotates
+
+
+def test_rotate_list_only_those_angles():
+    outs = _collect([("rotate_list", "0,180")], n=300)
+    r0 = _collect([("rotate", "0")], n=1)[0]
+    r180 = _collect([("rotate", "180")], n=1)[0]
+    match0 = np.array([np.allclose(o, r0, atol=1e-3) for o in outs])
+    match180 = np.array([np.allclose(o, r180, atol=1e-3) for o in outs])
+    assert ((match0 | match180)).all(), "angle outside rotate_list seen"
+    assert match0.any() and match180.any(), "list not sampled"
+
+
+def test_max_rotate_angle_spreads():
+    """Random rotation increases across-sample variance vs none."""
+    rot = _collect([("max_rotate_angle", "45")])
+    base = _collect([])
+    assert rot.std(axis=0).mean() > base.std(axis=0).mean() + 1.0
+
+
+def test_max_shear_ratio_spreads():
+    sh = _collect([("max_shear_ratio", "0.3")])
+    base = _collect([])
+    assert sh.std(axis=0).mean() > base.std(axis=0).mean() + 1.0
+
+
+def test_random_scale_range():
+    """min/max_random_scale: content size varies; mass conserved-ish on
+    upscale+crop vs heavy downscale shrinking the bright area."""
+    small = _collect([("min_random_scale", "0.5"),
+                      ("max_random_scale", "0.5")], size=32)
+    big = _collect([("min_random_scale", "1.0"),
+                    ("max_random_scale", "1.0")], size=32)
+    # downscaled content -> fewer bright pixels after the same crop
+    bright_small = (small > 100).mean()
+    bright_big = (big > 100).mean()
+    assert bright_small < bright_big * 0.75, (bright_small, bright_big)
+    # a range produces variation between samples
+    ranged = _collect([("min_random_scale", "0.5"),
+                       ("max_random_scale", "1.5"),
+                       ("min_img_size", "16")], size=32)
+    per_sample = (ranged > 100).reshape(len(ranged), -1).mean(axis=1)
+    assert per_sample.std() > 0.005
+
+
+def test_max_aspect_ratio_distorts():
+    """Aspect jitter makes the square's width/height ratio vary."""
+    outs = _collect([("max_aspect_ratio", "0.5")], n=200)
+    ratios = []
+    for o in outs:
+        mask = o[:, :, 0] > 100
+        if mask.sum() < 4:
+            continue
+        ys, xs = np.where(mask)
+        hh, ww = ys.max() - ys.min() + 1, xs.max() - xs.min() + 1
+        ratios.append(ww / hh)
+    ratios = np.asarray(ratios)
+    assert ratios.std() > 0.05, "aspect ratio did not vary"
+
+
+def test_min_max_img_size_clamps_canvas():
+    """min_img_size clamps the downscaled canvas so the target crop
+    still fits (no exception), and content shrinks inside it."""
+    outs = _collect([("min_random_scale", "0.4"),
+                     ("max_random_scale", "0.4"),
+                     ("min_img_size", "16")])
+    assert outs.shape[1:] == (16, 16, 3)
+
+
+def test_crop_size_range_resizes():
+    """min/max_crop_size: random crop size then resize to target; a
+    tight small crop zooms the content (more bright pixels than the
+    plain center crop)."""
+    zoomed = _collect([("min_crop_size", "8"), ("max_crop_size", "8")],
+                      size=24, target=16)
+    plain = _collect([], size=24, target=16)
+    assert (zoomed > 100).mean() > (plain > 100).mean() * 1.3
+    # range varies zoom across samples
+    ranged = _collect([("min_crop_size", "8"), ("max_crop_size", "20"),
+                       ("rand_crop", "1")])
+    per_sample = (ranged > 100).reshape(len(ranged), -1).mean(axis=1)
+    assert per_sample.std() > 0.01
+
+
+def test_rand_crop_varies_position():
+    outs = _collect([("rand_crop", "1")], size=24, target=12, n=100)
+    assert outs.std(axis=0).max() > 1.0
+
+
+def test_mirror_and_rand_mirror():
+    m = _collect([("mirror", "1")], n=1)
+    base = _collect([], n=1)
+    np.testing.assert_allclose(m[0], base[0][:, ::-1])
+    rm = _collect([("rand_mirror", "1")], n=100)
+    eq = np.array([np.allclose(o, base[0]) for o in rm])
+    assert eq.any() and (~eq).any(), "rand_mirror never/always mirrored"
+
+
+def test_contrast_illumination_jitter():
+    j = _collect([("max_random_contrast", "0.3"),
+                  ("max_random_illumination", "20")], n=100)
+    means = j.reshape(len(j), -1).mean(axis=1)
+    assert means.std() > 0.5

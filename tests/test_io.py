@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from cxxnet_tpu.io import create_iterator
-from cxxnet_tpu.io.data import DataInst
+from cxxnet_tpu.io.data import DataInst, IIterator
 from cxxnet_tpu.io.iter_batch import BatchAdapter, PrefetchIterator
 from cxxnet_tpu.io.iter_mnist import MNISTIterator
 
@@ -29,7 +29,7 @@ def write_idx(tmpdir, n=250, rows=8, cols=8, seed=0):
     return pimg, plab, img, lab
 
 
-class CountingIterator:
+class CountingIterator(IIterator):
     """Instance iterator emitting index-valued instances for testing."""
 
     def __init__(self, n, width=4):
@@ -158,6 +158,45 @@ def test_prefetch_iterator():
         got = [b.data[0, 0] for b in pf]
         np.testing.assert_allclose(got, [0, 5, 10, 15])
     pf.close()
+
+
+def test_prefetch_midepoch_restart():
+    """before_first mid-epoch must not serve a stale batch the producer
+    was already blocked on delivering (the double-buffer reset race:
+    drain-then-restart lost to a producer stuck in q.put)."""
+    base = CountingIterator(1000)
+    ba = BatchAdapter(base)
+    ba.set_param("batch_size", "5")
+    pf = PrefetchIterator(ba, capacity=2)
+    pf.init()
+    import time
+    for trial in range(20):
+        pf.before_first()
+        # consume a couple of batches, then reset mid-epoch at a point
+        # where the producer is likely blocked on a full queue
+        assert pf.next()
+        assert pf.next()
+        if trial % 3 == 0:
+            time.sleep(0.01)    # let the producer fill/block
+        pf.before_first()
+        assert pf.next()
+        first = pf.value()
+        assert first.data[0, 0] == 0, \
+            "stale batch after restart: got row %r" % first.data[0, 0]
+    pf.close()
+
+
+def test_prefetch_close_unblocks_producer():
+    """close() must terminate a producer blocked on a full queue."""
+    base = CountingIterator(10000)
+    ba = BatchAdapter(base)
+    ba.set_param("batch_size", "5")
+    pf = PrefetchIterator(ba, capacity=1)
+    pf.init()
+    pf.before_first()
+    assert pf.next()
+    pf.close()          # producer likely blocked in put; must exit
+    assert not pf._thread.is_alive()
 
 
 def test_factory_chain_mnist(tmp_path):

@@ -1,0 +1,68 @@
+"""MNIST end-to-end accuracy gate — the north-star correctness proof
+(reference example/MNIST/README.md:108 "~98%" MLP, :208 "~99%" convnet).
+
+Drives the REAL CLI path (cxxnet_tpu.main) with the REAL example configs
+(example/MNIST/*.conf), on idx data synthesized from sklearn's bundled
+handwritten digits (real scans; see example/MNIST/get_data.py).  Real
+MNIST dropped into example/MNIST/data is NOT used here — the test
+synthesizes its own smaller dataset into tmp for determinism and speed.
+"""
+
+import os
+import re
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MNIST_DIR = os.path.join(REPO, "example", "MNIST")
+
+
+def _prepare(tmp_path, n_train=12000, n_test=1500):
+    pytest.importorskip("sklearn")
+    pytest.importorskip("cv2")
+    sys.path.insert(0, MNIST_DIR)
+    try:
+        from get_data import synthesize
+    finally:
+        sys.path.remove(MNIST_DIR)
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    synthesize(str(data_dir), n_train=n_train, n_test=n_test, seed=1)
+    return data_dir
+
+
+def _run_conf(tmp_path, monkeypatch, capsys, conf_name, overrides):
+    """Run the CLI task from a cwd where ./data holds the idx files,
+    exactly like example/MNIST/run.sh does."""
+    from cxxnet_tpu.main import LearnTask
+    monkeypatch.chdir(tmp_path)
+    rc = LearnTask().run([os.path.join(MNIST_DIR, conf_name)]
+                         + overrides)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    errs = [float(m) for m in re.findall(r"test-error:([0-9.eE+-]+)",
+                                         out)]
+    assert errs, "no test-error lines printed:\n%s" % out
+    return errs
+
+
+def test_mnist_mlp_accuracy(tmp_path, monkeypatch, capsys):
+    _prepare(tmp_path)
+    errs = _run_conf(tmp_path, monkeypatch, capsys, "MNIST.conf",
+                     ["num_round=10"])
+    best = min(errs)
+    # reference MLP target: ~98%; gate at >=97% (error < 0.03)
+    assert best < 0.03, "MLP val error %.4f (want < 0.03); curve=%s" \
+        % (best, errs)
+
+
+def test_mnist_conv_accuracy(tmp_path, monkeypatch, capsys):
+    _prepare(tmp_path)
+    errs = _run_conf(tmp_path, monkeypatch, capsys, "MNIST_CONV.conf",
+                     ["num_round=12"])
+    best = min(errs)
+    # reference convnet target: ~99% (error < 0.01)
+    assert best < 0.01, "conv val error %.4f (want < 0.01); curve=%s" \
+        % (best, errs)

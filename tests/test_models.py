@@ -85,3 +85,30 @@ def test_inception_train_step_tiny():
     label = rng.randint(0, 8, (2, 1)).astype(np.float32)
     t.update(DataBatch(data=data, label=label))
     assert np.isfinite(t.last_loss)
+
+
+def test_inception_bn_multidevice_real_shapes():
+    """Pod-config rehearsal (VERDICT r1 #10): ONE update step of the
+    full Inception-BN config at 224x224 batch 32 on the 8-device
+    virtual mesh (dp=4 x tp=2), asserting finite loss and that the
+    intended shardings actually materialized."""
+    import jax
+    from cxxnet_tpu.parallel import make_mesh
+
+    mesh = make_mesh(4, 2)
+    conf = parse_config(inception_bn(nclass=1000, batch_size=32,
+                                     image_size=224)) \
+        + [("model_parallel_min", "512"), ("shard_optimizer", "1")]
+    t = NetTrainer(conf, mesh=mesh)
+    t.init_model()
+    rng = np.random.RandomState(0)
+    data = rng.rand(32, 224, 224, 3).astype(np.float32)
+    label = rng.randint(0, 1000, (32, 1)).astype(np.float32)
+    t.update(DataBatch(data=data, label=label))
+    assert np.isfinite(t.last_loss), "non-finite loss on full config"
+    # batch is sharded over 'data'; the big fc weight over 'model'
+    fc = t.params["fc1"]["wmat"]
+    assert tuple(fc.sharding.spec) == (None, "model"), fc.sharding
+    # ZeRO-1: momentum of a data-shardable weight lives on 'data'
+    m = t.opt_state["fc1"]["wmat"]["m_w"]
+    assert tuple(m.sharding.spec)[0] == "data", m.sharding

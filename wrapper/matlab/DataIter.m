@@ -1,0 +1,46 @@
+classdef DataIter < handle
+    % cxxnet_tpu data iterator (counterpart of the reference
+    % wrapper/matlab/DataIter.m, over this framework's C ABI).
+    properties (Access = private)
+        handle_
+        head_
+        tail_
+    end
+
+    methods
+        function obj = DataIter(cfg)
+            assert(ischar(cfg));
+            obj.head_ = true;
+            obj.tail_ = false;
+            obj.handle_ = cxxnet_mex('MEXCXNIOCreateFromConfig', cfg);
+        end
+        function delete(obj)
+            cxxnet_mex('MEXCXNIOFree', obj.handle_);
+        end
+        function h = handle(obj)
+            h = obj.handle_;
+        end
+        function ret = next(obj)
+            ret = cxxnet_mex('MEXCXNIONext', obj.handle_) ~= 0;
+            obj.head_ = false;
+            obj.tail_ = ~ret;
+        end
+        function before_first(obj)
+            cxxnet_mex('MEXCXNIOBeforeFirst', obj.handle_);
+            obj.head_ = true;
+            obj.tail_ = false;
+        end
+        function check_valid(obj)
+            assert(~obj.head_, 'iterator is at head: call next() first');
+            assert(~obj.tail_, 'iterator is at end');
+        end
+        function d = get_data(obj)
+            assert(~obj.tail_, 'iterator is at end');
+            d = cxxnet_mex('MEXCXNIOGetData', obj.handle_);
+        end
+        function l = get_label(obj)
+            assert(~obj.tail_, 'iterator is at end');
+            l = cxxnet_mex('MEXCXNIOGetLabel', obj.handle_);
+        end
+    end
+end

@@ -1,0 +1,74 @@
+classdef Net < handle
+    % cxxnet_tpu network handle (counterpart of the reference
+    % wrapper/matlab/Net.m, over this framework's C ABI via cxxnet_mex).
+    properties (Access = private)
+        handle_
+    end
+
+    methods
+        function obj = Net(dev, cfg)
+            assert(ischar(dev) && ischar(cfg));
+            obj.handle_ = cxxnet_mex('MEXCXNNetCreate', dev, cfg);
+        end
+        function delete(obj)
+            cxxnet_mex('MEXCXNNetFree', obj.handle_);
+        end
+        function set_param(obj, key, val)
+            cxxnet_mex('MEXCXNNetSetParam', obj.handle_, key, ...
+                       num2str(val));
+        end
+        function init_model(obj)
+            cxxnet_mex('MEXCXNNetInitModel', obj.handle_);
+        end
+        function load_model(obj, fname)
+            cxxnet_mex('MEXCXNNetLoadModel', obj.handle_, fname);
+        end
+        function save_model(obj, fname)
+            cxxnet_mex('MEXCXNNetSaveModel', obj.handle_, fname);
+        end
+        function start_round(obj, r)
+            cxxnet_mex('MEXCXNNetStartRound', obj.handle_, r);
+        end
+        function update(obj, data, label)
+            % update(DataIter) or update(batch4d, label)
+            if isobject(data)
+                data.check_valid();
+                cxxnet_mex('MEXCXNNetUpdateIter', obj.handle_, ...
+                           data.handle());
+            else
+                cxxnet_mex('MEXCXNNetUpdateBatch', obj.handle_, ...
+                           single(data), single(label));
+            end
+        end
+        function out = predict(obj, data)
+            if isobject(data)
+                out = cxxnet_mex('MEXCXNNetPredictIter', obj.handle_, ...
+                                 data.handle());
+            else
+                out = cxxnet_mex('MEXCXNNetPredictBatch', obj.handle_, ...
+                                 single(data));
+            end
+        end
+        function out = extract(obj, data, node_name)
+            if isobject(data)
+                out = cxxnet_mex('MEXCXNNetExtractIter', obj.handle_, ...
+                                 data.handle(), node_name);
+            else
+                out = cxxnet_mex('MEXCXNNetExtractBatch', obj.handle_, ...
+                                 single(data), node_name);
+            end
+        end
+        function s = evaluate(obj, data, name)
+            s = cxxnet_mex('MEXCXNNetEvaluate', obj.handle_, ...
+                           data.handle(), name);
+        end
+        function set_weight(obj, w, layer_name, tag)
+            cxxnet_mex('MEXCXNNetSetWeight', obj.handle_, single(w), ...
+                       layer_name, tag);
+        end
+        function w = get_weight(obj, layer_name, tag)
+            w = cxxnet_mex('MEXCXNNetGetWeight', obj.handle_, ...
+                           layer_name, tag);
+        end
+    end
+end

@@ -1,0 +1,212 @@
+/*!
+ * Matlab mex dispatch over the cxxnet_tpu C ABI (wrapper/cxxnet_wrapper.h)
+ * — the counterpart of the reference's wrapper/matlab/cxxnet_mex.cpp,
+ * written against this framework's C API.
+ *
+ * Build (needs a Matlab installation; see README.md in this directory):
+ *   mex cxxnet_mex.cpp -L../../lib -lcxxnet_wrapper -I..
+ *
+ * Command protocol: cxxnet_mex('<Cmd>', args...) where <Cmd> mirrors the
+ * C ABI name with a MEX prefix, e.g. MEXCXNNetCreate.  Handles travel as
+ * uint64 scalars.  Matlab arrays are column-major; batch tensors cross
+ * the boundary transposed to the C row-major NCHW layout.
+ */
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+#include "mex.h"
+#include "../cxxnet_wrapper.h"
+
+static mxArray *MakeHandle(void *p) {
+  mxArray *out = mxCreateNumericMatrix(1, 1, mxUINT64_CLASS, mxREAL);
+  *reinterpret_cast<uint64_t *>(mxGetData(out)) =
+      reinterpret_cast<uint64_t>(p);
+  return out;
+}
+
+static void *ReadHandle(const mxArray *a) {
+  return reinterpret_cast<void *>(
+      *reinterpret_cast<const uint64_t *>(mxGetData(a)));
+}
+
+static std::string ReadString(const mxArray *a) {
+  char *s = mxArrayToString(a);
+  if (s == NULL) mexErrMsgTxt("expected a string argument");
+  std::string out(s);
+  mxFree(s);
+  return out;
+}
+
+static void CheckErr(void) {
+  const char *msg = CXNGetLastError();
+  if (msg != NULL && msg[0] != '\0') mexErrMsgTxt(msg);
+}
+
+/* column-major (d0 fastest) <-> row-major flat copies for a 4-D batch */
+static std::vector<cxn_real_t> ToRowMajor4(const mxArray *a,
+                                           cxn_uint shape[4]) {
+  if (!mxIsSingle(a)) mexErrMsgTxt("batch data must be single()");
+  const mwSize nd = mxGetNumberOfDimensions(a);
+  const mwSize *dims = mxGetDimensions(a);
+  mwSize d[4] = {1, 1, 1, 1};
+  for (mwSize i = 0; i < nd && i < 4; ++i) d[i] = dims[i];
+  /* Matlab (batch, ch, h, w) column-major -> C NCHW row-major */
+  const float *src = reinterpret_cast<const float *>(mxGetData(a));
+  std::vector<cxn_real_t> out(d[0] * d[1] * d[2] * d[3]);
+  for (mwSize n = 0; n < d[0]; ++n)
+    for (mwSize c = 0; c < d[1]; ++c)
+      for (mwSize h = 0; h < d[2]; ++h)
+        for (mwSize w = 0; w < d[3]; ++w)
+          out[((n * d[1] + c) * d[2] + h) * d[3] + w] =
+              src[n + d[0] * (c + d[1] * (h + d[2] * w))];
+  for (int i = 0; i < 4; ++i) shape[i] = (cxn_uint)d[i];
+  return out;
+}
+
+static mxArray *FromRowMajor(const cxn_real_t *p, const cxn_uint shape[4],
+                             int ndim) {
+  mwSize dims[4];
+  for (int i = 0; i < ndim; ++i) dims[i] = shape[i];
+  mxArray *out = mxCreateNumericArray(ndim, dims, mxSINGLE_CLASS, mxREAL);
+  float *dst = reinterpret_cast<float *>(mxGetData(out));
+  /* row-major source -> column-major destination */
+  mwSize total = 1;
+  for (int i = 0; i < ndim; ++i) total *= shape[i];
+  std::vector<mwSize> stride_r(ndim), stride_c(ndim);
+  mwSize sr = 1, sc = 1;
+  for (int i = ndim - 1; i >= 0; --i) { stride_r[i] = sr; sr *= shape[i]; }
+  for (int i = 0; i < ndim; ++i) { stride_c[i] = sc; sc *= shape[i]; }
+  for (mwSize flat = 0; flat < total; ++flat) {
+    mwSize rem = flat, ci = 0;
+    for (int i = 0; i < ndim; ++i) {
+      mwSize idx = rem / stride_r[i];
+      rem %= stride_r[i];
+      ci += idx * stride_c[i];
+    }
+    dst[ci] = p[flat];
+  }
+  return out;
+}
+
+void mexFunction(int nlhs, mxArray *plhs[], int nrhs,
+                 const mxArray *prhs[]) {
+  if (nrhs < 1) mexErrMsgTxt("usage: cxxnet_mex('<Cmd>', ...)");
+  std::string cmd = ReadString(prhs[0]);
+
+  if (cmd == "MEXCXNIOCreateFromConfig") {
+    void *h = CXNIOCreateFromConfig(ReadString(prhs[1]).c_str());
+    CheckErr();
+    plhs[0] = MakeHandle(h);
+  } else if (cmd == "MEXCXNIONext") {
+    plhs[0] = mxCreateDoubleScalar(CXNIONext(ReadHandle(prhs[1])));
+  } else if (cmd == "MEXCXNIOBeforeFirst") {
+    CXNIOBeforeFirst(ReadHandle(prhs[1]));
+  } else if (cmd == "MEXCXNIOGetData") {
+    cxn_uint shape[4], stride;
+    const cxn_real_t *p = CXNIOGetData(ReadHandle(prhs[1]), shape, &stride);
+    CheckErr();
+    plhs[0] = FromRowMajor(p, shape, 4);
+  } else if (cmd == "MEXCXNIOGetLabel") {
+    cxn_uint shape[2], stride;
+    const cxn_real_t *p = CXNIOGetLabel(ReadHandle(prhs[1]), shape, &stride);
+    CheckErr();
+    cxn_uint s4[4] = {shape[0], shape[1], 1, 1};
+    plhs[0] = FromRowMajor(p, s4, 2);
+  } else if (cmd == "MEXCXNIOFree") {
+    CXNIOFree(ReadHandle(prhs[1]));
+  } else if (cmd == "MEXCXNNetCreate") {
+    void *h = CXNNetCreate(ReadString(prhs[1]).c_str(),
+                           ReadString(prhs[2]).c_str());
+    CheckErr();
+    plhs[0] = MakeHandle(h);
+  } else if (cmd == "MEXCXNNetFree") {
+    CXNNetFree(ReadHandle(prhs[1]));
+  } else if (cmd == "MEXCXNNetSetParam") {
+    CXNNetSetParam(ReadHandle(prhs[1]), ReadString(prhs[2]).c_str(),
+                   ReadString(prhs[3]).c_str());
+  } else if (cmd == "MEXCXNNetInitModel") {
+    CXNNetInitModel(ReadHandle(prhs[1]));
+    CheckErr();
+  } else if (cmd == "MEXCXNNetSaveModel") {
+    CXNNetSaveModel(ReadHandle(prhs[1]), ReadString(prhs[2]).c_str());
+    CheckErr();
+  } else if (cmd == "MEXCXNNetLoadModel") {
+    CXNNetLoadModel(ReadHandle(prhs[1]), ReadString(prhs[2]).c_str());
+    CheckErr();
+  } else if (cmd == "MEXCXNNetStartRound") {
+    CXNNetStartRound(ReadHandle(prhs[1]), (int)mxGetScalar(prhs[2]));
+  } else if (cmd == "MEXCXNNetUpdateIter") {
+    CXNNetUpdateIter(ReadHandle(prhs[1]), ReadHandle(prhs[2]));
+    CheckErr();
+  } else if (cmd == "MEXCXNNetUpdateBatch") {
+    cxn_uint dshape[4], lshape4[4];
+    std::vector<cxn_real_t> data = ToRowMajor4(prhs[2], dshape);
+    std::vector<cxn_real_t> label = ToRowMajor4(prhs[3], lshape4);
+    cxn_uint lshape[2] = {lshape4[0], lshape4[1]};
+    CXNNetUpdateBatch(ReadHandle(prhs[1]), data.data(), dshape,
+                      label.data(), lshape);
+    CheckErr();
+  } else if (cmd == "MEXCXNNetPredictBatch") {
+    cxn_uint dshape[4], out_size;
+    std::vector<cxn_real_t> data = ToRowMajor4(prhs[2], dshape);
+    const cxn_real_t *p = CXNNetPredictBatch(ReadHandle(prhs[1]),
+                                             data.data(), dshape,
+                                             &out_size);
+    CheckErr();
+    cxn_uint s4[4] = {out_size, 1, 1, 1};
+    plhs[0] = FromRowMajor(p, s4, 1);
+  } else if (cmd == "MEXCXNNetPredictIter") {
+    cxn_uint out_size;
+    const cxn_real_t *p = CXNNetPredictIter(ReadHandle(prhs[1]),
+                                            ReadHandle(prhs[2]),
+                                            &out_size);
+    CheckErr();
+    cxn_uint s4[4] = {out_size, 1, 1, 1};
+    plhs[0] = FromRowMajor(p, s4, 1);
+  } else if (cmd == "MEXCXNNetExtractBatch") {
+    cxn_uint dshape[4], oshape[4];
+    std::vector<cxn_real_t> data = ToRowMajor4(prhs[2], dshape);
+    const cxn_real_t *p = CXNNetExtractBatch(ReadHandle(prhs[1]),
+                                             data.data(), dshape,
+                                             ReadString(prhs[3]).c_str(),
+                                             oshape);
+    CheckErr();
+    plhs[0] = FromRowMajor(p, oshape, 4);
+  } else if (cmd == "MEXCXNNetExtractIter") {
+    cxn_uint oshape[4];
+    const cxn_real_t *p = CXNNetExtractIter(ReadHandle(prhs[1]),
+                                            ReadHandle(prhs[2]),
+                                            ReadString(prhs[3]).c_str(),
+                                            oshape);
+    CheckErr();
+    plhs[0] = FromRowMajor(p, oshape, 4);
+  } else if (cmd == "MEXCXNNetEvaluate") {
+    const char *s = CXNNetEvaluate(ReadHandle(prhs[1]),
+                                   ReadHandle(prhs[2]),
+                                   ReadString(prhs[3]).c_str());
+    CheckErr();
+    plhs[0] = mxCreateString(s == NULL ? "" : s);
+  } else if (cmd == "MEXCXNNetSetWeight") {
+    cxn_uint wshape[4];
+    std::vector<cxn_real_t> w = ToRowMajor4(prhs[2], wshape);
+    CXNNetSetWeight(ReadHandle(prhs[1]), w.data(), (cxn_uint)w.size(),
+                    ReadString(prhs[3]).c_str(),
+                    ReadString(prhs[4]).c_str());
+    CheckErr();
+  } else if (cmd == "MEXCXNNetGetWeight") {
+    cxn_uint oshape[4], odim;
+    const cxn_real_t *p = CXNNetGetWeight(ReadHandle(prhs[1]),
+                                          ReadString(prhs[2]).c_str(),
+                                          ReadString(prhs[3]).c_str(),
+                                          oshape, &odim);
+    CheckErr();
+    if (p == NULL || odim == 0) {
+      plhs[0] = mxCreateNumericMatrix(0, 0, mxSINGLE_CLASS, mxREAL);
+    } else {
+      plhs[0] = FromRowMajor(p, oshape, (int)odim);
+    }
+  } else {
+    mexErrMsgTxt(("unknown command: " + cmd).c_str());
+  }
+}
