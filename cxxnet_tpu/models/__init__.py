@@ -12,6 +12,7 @@ from .mnist import mnist_mlp, mnist_conv
 from .alexnet import alexnet
 from .inception import inception_bn
 from .bowl import kaggle_bowl
+from .kaiming import kaiming
 
 __all__ = ["mnist_mlp", "mnist_conv", "alexnet", "inception_bn",
-           "kaggle_bowl"]
+           "kaggle_bowl", "kaiming"]

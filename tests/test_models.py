@@ -6,7 +6,7 @@ import pytest
 
 from cxxnet_tpu.io.data import DataBatch
 from cxxnet_tpu.models import (alexnet, inception_bn, kaggle_bowl,
-                               mnist_conv, mnist_mlp)
+                               kaiming, mnist_conv, mnist_mlp)
 from cxxnet_tpu.nnet.net import FuncNet
 from cxxnet_tpu.nnet.trainer import NetTrainer
 from cxxnet_tpu.graph import NetGraph
@@ -57,10 +57,27 @@ def test_kaggle_bowl_shapes():
     assert net.node_shapes[-1].x == 121
 
 
+def test_kaiming_shapes():
+    g, net = _shapes(kaiming())
+    # He-J' at 224: stem 7x7/2 -> 109, pool3/1 ceil -> 107; stage pools
+    # land at 35 and 16; conv11 (2x2 pad1 over the 5-wide conv10 map)
+    # gives 6; SPP concat = 256*(36+9+4+1) = 12800
+    assert net.node_shapes[1] == (64, 109, 109)
+    assert net.node_shapes[3] == (64, 107, 107)
+    assert net.node_shapes[12] == (128, 35, 35)
+    assert net.node_shapes[21] == (256, 16, 16)
+    assert net.node_shapes[24] == (256, 6, 6)
+    assert net.node_shapes[38].x == 12800
+    assert net.node_shapes[-1].x == 1000
+
+
 @pytest.mark.parametrize("conf_fn,shape,nclass", [
     (lambda: alexnet(nclass=10, batch_size=4, image_size=67), (4, 67, 67, 3), 10),
     (lambda: kaggle_bowl(nclass=5, batch_size=4), (4, 40, 40, 3), 5),
     (lambda: mnist_conv(batch_size=4), (4, 28, 28, 1), 10),
+    # 208 is near the smallest size where the SPP k6 pool still sees >=6
+    # pixels (the reference's pre-pad "kernel size exceed input" check)
+    (lambda: kaiming(nclass=10, batch_size=2, image_size=208), (2, 208, 208, 3), 10),
 ])
 def test_models_train_step(conf_fn, shape, nclass):
     t = NetTrainer(parse_config(conf_fn()))
