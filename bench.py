@@ -22,23 +22,37 @@ import numpy as np
 BASELINE_IMAGES_PER_SEC = 1500.0
 
 
-def measure(steps: int = 200, batch: int = 256,
+# model name (= builder in cxxnet_tpu.models) -> (default batch, image
+# size); image sizes follow the reference confs: AlexNet 227
+# (ImageNet/README.md), Inception-BN and kaiming 224.
+MODELS = {
+    "alexnet": (256, 227),
+    "inception_bn": (128, 224),
+    "kaiming": (128, 224),
+}
+
+
+def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
             dtype: str = "bfloat16") -> float:
     import jax
+    import cxxnet_tpu.models as zoo
     from cxxnet_tpu.io.data import DataBatch
-    from cxxnet_tpu.models import alexnet
     from cxxnet_tpu.nnet.trainer import NetTrainer
     from cxxnet_tpu.utils.config import parse_config
 
-    t = NetTrainer(parse_config(alexnet(nclass=1000, batch_size=batch,
-                                        image_size=227))
+    default_batch, size = MODELS[model]
+    if batch is None:
+        batch = default_batch
+    builder = getattr(zoo, model)
+    t = NetTrainer(parse_config(builder(nclass=1000, batch_size=batch,
+                                        image_size=size))
                    + [("eval_train", "0"), ("dtype", dtype)])
     t.init_model()
 
     rng = np.random.RandomState(0)
     b = DataBatch(
         data=t._put_batch_array(
-            rng.rand(batch, 227, 227, 3).astype(np.float32)),
+            rng.rand(batch, size, size, 3).astype(np.float32)),
         label=t._put_batch_array(
             rng.randint(0, 1000, (batch, 1)).astype(np.float32)))
 
@@ -137,9 +151,21 @@ def main():
             "pure_compute_images_per_sec": round(pure, 1),
         }))
         return
-    ips = measure()
+    model = "alexnet"
+    if "--model" in sys.argv:
+        model = sys.argv[sys.argv.index("--model") + 1]
+    steps = 200 if model == "alexnet" else 50
+    if "--steps" in sys.argv:
+        steps = int(sys.argv[sys.argv.index("--steps") + 1])
+    batch = None
+    if "--batch" in sys.argv:
+        batch = int(sys.argv[sys.argv.index("--batch") + 1])
+    ips = measure(steps=steps, batch=batch, model=model)
+    # 'AlexNet' spelling keeps the canonical BENCH metric name stable
+    # across rounds
+    name = "AlexNet" if model == "alexnet" else model
     print(json.dumps({
-        "metric": "images/sec/chip on ImageNet AlexNet",
+        "metric": "images/sec/chip on ImageNet %s" % name,
         "value": round(ips, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(ips / BASELINE_IMAGES_PER_SEC, 3),

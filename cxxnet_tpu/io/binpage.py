@@ -12,13 +12,15 @@ from typing import Iterator, List
 
 import numpy as np
 
+from ..utils.stream import open_stream
+
 KPAGE_WORDS = 64 << 18
 KPAGE_BYTES = KPAGE_WORDS * 4
 
 
 def read_pages(path: str) -> Iterator[List[bytes]]:
     """Yield the list of objects of each page."""
-    with open(path, "rb") as f:
+    with open_stream(path, "rb") as f:
         while True:
             raw = f.read(KPAGE_BYTES)
             if not raw:
@@ -49,7 +51,7 @@ class PageWriter:
     pure-Python im2bin fallback path)."""
 
     def __init__(self, path: str):
-        self._f = open(path, "wb")
+        self._f = open_stream(path, "wb")
         self._objs: List[bytes] = []
         self._used = 0                   # payload bytes in current page
 

@@ -19,6 +19,7 @@ from typing import Dict, List
 import numpy as np
 
 from .data import DataBatch, IIterator
+from ..utils.stream import open_stream
 
 
 class AttachTxtIterator(IIterator):
@@ -44,7 +45,7 @@ class AttachTxtIterator(IIterator):
     def init(self) -> None:
         self.base.init()
         assert self.filename, "attachtxt: filename must be set"
-        with open(self.filename, "r") as f:
+        with open_stream(self.filename, "r") as f:
             tokens = f.read().split()
         assert tokens, "attachtxt: empty file %s" % self.filename
         self.dim = int(tokens[0])

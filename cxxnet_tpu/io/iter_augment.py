@@ -25,6 +25,7 @@ from typing import List, Optional
 import numpy as np
 
 from .data import DataInst, IIterator, shape_from_conf
+from ..utils.stream import open_stream, stream_exists
 
 
 class AugmentAdapter(IIterator):
@@ -134,8 +135,9 @@ class AugmentAdapter(IIterator):
             return
         path = self.name_meanimg
         npy = path if path.endswith(".npy") else path + ".npy"
-        if os.path.exists(npy):
-            self.meanimg = np.load(npy)
+        if stream_exists(npy):
+            with open_stream(npy, "rb") as f:
+                self.meanimg = np.load(f)
             return
         # compute over one pass (CreateMeanImg semantics)
         if self.silent == 0:
@@ -147,7 +149,8 @@ class AugmentAdapter(IIterator):
             total = d.copy() if total is None else total + d
             cnt += 1
         self.meanimg = total / max(cnt, 1)
-        np.save(npy, self.meanimg)
+        with open_stream(npy, "wb") as f:
+            np.save(f, self.meanimg)
 
     def init(self) -> None:
         self.base.init()

@@ -11,6 +11,7 @@ from typing import Optional
 import numpy as np
 
 from .data import DataInst, IIterator, inst_array_shape, shape_from_conf
+from ..utils.stream import open_stream
 
 
 class CSVIterator(IIterator):
@@ -38,8 +39,9 @@ class CSVIterator(IIterator):
 
     def init(self) -> None:
         skip = 1 if self.has_header else 0
-        self.rows = np.loadtxt(self.filename, delimiter=",",
-                               skiprows=skip, dtype=np.float32, ndmin=2)
+        with open_stream(self.filename, "r") as f:
+            self.rows = np.loadtxt(f, delimiter=",", skiprows=skip,
+                                   dtype=np.float32, ndmin=2)
         nfeat = self.shape[0] * self.shape[1] * self.shape[2]
         if self.rows.shape[1] != self.label_width + nfeat:
             raise ValueError(

@@ -15,6 +15,7 @@ from typing import List, Optional
 import numpy as np
 
 from .data import DataInst, IIterator
+from ..utils.stream import open_stream
 
 
 class ImageIterator(IIterator):
@@ -46,7 +47,7 @@ class ImageIterator(IIterator):
 
     def init(self) -> None:
         self.rows = []
-        with open(self.image_list) as f:
+        with open_stream(self.image_list, "r") as f:
             for line in f:
                 toks = line.split()
                 if not toks:

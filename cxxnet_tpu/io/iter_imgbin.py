@@ -27,6 +27,7 @@ import numpy as np
 
 from .binpage import iter_objects
 from .data import DataInst, IIterator
+from ..utils.stream import open_stream
 
 
 def _decode(args: Tuple[int, np.ndarray, bytes]) -> Optional[DataInst]:
@@ -111,7 +112,7 @@ class ImageBinIterator(IIterator):
 
     def _read_list(self, path: str) -> List[Tuple[int, np.ndarray]]:
         rows = []
-        with open(path) as f:
+        with open_stream(path, "r") as f:
             for line in f:
                 toks = line.split()
                 if not toks:

@@ -26,6 +26,7 @@ import numpy as np
 
 from .data import DataInst, IIterator
 from .recordio import RecordIOReader, unpack_image_record
+from ..utils.stream import open_stream
 
 
 class ImageRecordIterator(IIterator):
@@ -101,7 +102,7 @@ class ImageRecordIterator(IIterator):
                     self._readers.append(RecordIOReader(p, 0, 1))
         if self.path_imglist:
             self._label_map = {}
-            with open(self.path_imglist) as f:
+            with open_stream(self.path_imglist, "r") as f:
                 for line in f:
                     toks = line.split()
                     if not toks:

@@ -22,12 +22,26 @@ import numpy as np
 from .data import DataBatch, IIterator
 
 
+class _ClosingGzip(gzip.GzipFile):
+    """GzipFile that also closes the externally supplied fileobj
+    (GzipFile.close() deliberately leaves it open)."""
+
+    def close(self):
+        fo = self.fileobj
+        try:
+            super().close()
+        finally:
+            if fo is not None:
+                fo.close()
+
+
 def _open(path: str):
-    if path.endswith(".gz") or not os.path.exists(path) and \
-            os.path.exists(path + ".gz"):
-        return gzip.open(path if path.endswith(".gz") else path + ".gz",
-                         "rb")
-    return open(path, "rb")
+    from ..utils.stream import open_stream, stream_exists
+    if path.endswith(".gz") or not stream_exists(path) and \
+            stream_exists(path + ".gz"):
+        gz = path if path.endswith(".gz") else path + ".gz"
+        return _ClosingGzip(fileobj=open_stream(gz, "rb"))
+    return open_stream(path, "rb")
 
 
 def read_idx_images(path: str) -> np.ndarray:

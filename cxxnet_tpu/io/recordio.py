@@ -15,6 +15,8 @@ from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
+from ..utils.stream import local_path, open_stream, uri_scheme
+
 KMAGIC = 0xCED7230A
 _MAGIC_BYTES = struct.pack("<I", KMAGIC)
 
@@ -56,7 +58,7 @@ def native_available() -> bool:
 
 class _PyWriter:
     def __init__(self, path: str):
-        self._f = open(path, "wb")
+        self._f = open_stream(path, "wb")
 
     def write_record(self, data: bytes) -> None:
         n = len(data)
@@ -113,8 +115,10 @@ class _NativeWriter:
 
 
 def RecordIOWriter(path: str, force_python: bool = False):
-    if _lib is not None and not force_python:
-        return _NativeWriter(path)
+    # remote URIs go through the Python writer (open_stream); the
+    # native C writer fopen()s local paths only
+    if _lib is not None and not force_python and uri_scheme(path) == "":
+        return _NativeWriter(local_path(path))
     return _PyWriter(path)
 
 
@@ -123,7 +127,7 @@ def RecordIOWriter(path: str, force_python: bool = False):
 class _PyReader:
     def __init__(self, path: str, part_index: int = 0,
                  num_parts: int = 1):
-        self._f = open(path, "rb")
+        self._f = open_stream(path, "rb")
         self._f.seek(0, 2)
         fsize = self._f.tell()
         if num_parts <= 1:
@@ -229,8 +233,8 @@ class _NativeReader:
 
 def RecordIOReader(path: str, part_index: int = 0, num_parts: int = 1,
                    force_python: bool = False):
-    if _lib is not None and not force_python:
-        return _NativeReader(path, part_index, num_parts)
+    if _lib is not None and not force_python and uri_scheme(path) == "":
+        return _NativeReader(local_path(path), part_index, num_parts)
     return _PyReader(path, part_index, num_parts)
 
 

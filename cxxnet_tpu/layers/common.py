@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .base import Layer, LayerParam, Shape3, as_mat
+from ..utils.stream import open_stream
 
 
 class FullConnectLayer(Layer):
@@ -410,7 +411,7 @@ class FixConnectLayer(Layer):
         self.in_shapes = [s]
         self.out_shapes = [Shape3(1, 1, self.param.num_hidden)]
         w = np.zeros((self.param.num_hidden, s.x), np.float32)
-        with open(self.fname_weight) as f:
+        with open_stream(self.fname_weight, "r") as f:
             toks = f.read().split()
         nrow, ncol, nnz = int(toks[0]), int(toks[1]), int(toks[2])
         if (nrow, ncol) != w.shape:

@@ -26,6 +26,7 @@ from .nnet.trainer import NetTrainer
 from .parallel import init_distributed, is_root
 from .utils.config import (parse_cli_overrides, parse_config_file,
                            split_sections)
+from .utils.stream import open_stream
 
 _MODEL_RE = re.compile(r"^(\d{4})\.model\.npz$")
 
@@ -238,7 +239,7 @@ class LearnTask:
 
     def _task_predict(self, trainer, itr) -> int:
         assert itr is not None, "pred requires an iterator"
-        with open(self.name_pred, "w") as f:
+        with open_stream(self.name_pred, "w") as f:
             for batch in itr:
                 for v in trainer.predict(batch):
                     f.write("%g\n" % v)
@@ -248,7 +249,7 @@ class LearnTask:
     def _task_extract(self, trainer, itr) -> int:
         assert itr is not None, "extract requires an iterator"
         node = self.extract_node_name
-        with open(self.name_pred, "w") as f:
+        with open_stream(self.name_pred, "w") as f:
             for batch in itr:
                 feats = trainer.extract_feature(batch, node)
                 feats = feats.reshape(feats.shape[0], -1)

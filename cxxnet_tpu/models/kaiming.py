@@ -14,7 +14,8 @@ time cost unchanged" (/root/reference/example/ImageNet/README.md:47).
 
 def _stage(lines, idx, node, convs, pool=None):
     """Append `convs` = [(nchannel, kernel, stride, pad), ...] then an
-    optional (kernel, stride) max pool; returns (lines, idx, node)."""
+    optional (kernel, stride) max pool to `lines` in place; returns the
+    advanced (idx, node) counters."""
     for (nch, k, s, p) in convs:
         lines.append("layer[%d->%d] = conv:conv%d" % (node, node + 1, idx))
         lines.append("  nchannel = %d" % nch)

@@ -50,6 +50,7 @@ from ..parallel import (batch_sharding, make_mesh, opt_state_sharding,
 from ..updater import create_updater
 from ..utils.config import ConfigPairs
 from ..utils.metric import MetricSet
+from ..utils.stream import open_stream
 from .net import FuncNet
 
 _RE_METRIC = re.compile(r"^metric(?:\[([^\]]*)\])?$")
@@ -536,11 +537,13 @@ class NetTrainer:
         }
         arrays["__meta__"] = np.frombuffer(
             json.dumps(meta).encode(), np.uint8)
-        with open(path, "wb") as f:
+        with open_stream(path, "wb") as f:
             np.savez(f, **arrays)
 
     def load_model(self, path: str) -> None:
-        blob = np.load(path, allow_pickle=False)
+        # materialize while the stream is open (npz members load lazily)
+        with open_stream(path, "rb") as f:
+            blob = dict(np.load(f, allow_pickle=False))
         meta = json.loads(bytes(blob["__meta__"]).decode())
         saved_graph = NetGraph.from_dict(meta["structure"])
         self._absorb_globals()
@@ -571,7 +574,8 @@ class NetTrainer:
         """Finetune: copy weights for layers whose *names* match
         (nnet_impl-inl.hpp:117-150). Call after init_model."""
         assert self._initialized
-        blob = np.load(path, allow_pickle=False)
+        with open_stream(path, "rb") as f:
+            blob = dict(np.load(f, allow_pickle=False))
         copied = []
         for lk, pt in self.params.items():
             hit = {}

@@ -29,13 +29,14 @@ import numpy as np
 
 from ..nnet.trainer import NetTrainer
 from ..utils.config import parse_config_file
+from ..utils.stream import open_stream
 
 
 def load_source(path: str) -> Dict[str, np.ndarray]:
     """Load a torch state dict (.pth/.pt) or a .npz into flat arrays."""
     if path.endswith(".npz"):
-        blob = np.load(path)
-        return {k: np.asarray(blob[k]) for k in blob.files}
+        with open_stream(path, "rb") as f:
+            return dict(np.load(f))
     import torch
     sd = torch.load(path, map_location="cpu", weights_only=True)
     if hasattr(sd, "state_dict"):
@@ -59,7 +60,7 @@ def convert(src_path: str, conf_path: str, out_path: str,
     src = load_source(src_path)
     name_map: Dict[str, str] = {}
     if map_path:
-        with open(map_path) as f:
+        with open_stream(map_path, "r") as f:
             for line in f:
                 toks = line.split()
                 if len(toks) >= 2:

@@ -14,6 +14,7 @@ reference tokenizer (``/root/reference/src/utils/config.h:20-192``):
 from __future__ import annotations
 
 from typing import Iterator, List, Tuple
+from .stream import open_stream
 
 ConfigPairs = List[Tuple[str, str]]
 
@@ -70,7 +71,7 @@ def parse_config(text: str) -> ConfigPairs:
 
 
 def parse_config_file(path: str) -> ConfigPairs:
-    with open(path, "r") as f:
+    with open_stream(path, "r") as f:
         return parse_config(f.read())
 
 

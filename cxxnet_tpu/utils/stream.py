@@ -1,0 +1,101 @@
+"""Pluggable stream layer: URI-addressed file I/O for every repo open().
+
+TPU-era equivalent of dmlc ``Stream::Create`` / the HDFS-S3 stream
+abstraction the reference compiles in behind ``make/config.mk:79-88``
+(USE_HDFS / USE_S3) and uses for model and data paths at
+``cxxnet_main.cpp:93,189``. One function — ``open_stream(uri, mode)`` —
+is the single choke point for model save/load, the mean-image cache,
+config files, and every data iterator:
+
+* plain local paths (and ``file://``) use the builtin ``open``;
+* URIs with a scheme (``gs://``, ``s3://``, ``hdfs://``, ``http://``,
+  ``memory://``, ...) go through ``fsspec`` when it is importable;
+* a scheme with no fsspec installed raises a clear error instead of a
+  confusing FileNotFoundError;
+* tests (and users) can register custom schemes with
+  ``register_scheme`` without fsspec — the hook a mock filesystem uses.
+"""
+
+import builtins
+import os
+import re
+from typing import Callable, Dict
+
+# scheme -> open(path_without_scheme_prefixing_rules, mode) -> file obj.
+# Registered openers receive the FULL uri (scheme included) so they can
+# interpret it however the backing store wants.
+_SCHEMES: Dict[str, Callable] = {}
+
+# 2+ chars so Windows drive letters ('C://...') stay local, as in
+# fsspec/dmlc
+_URI_RE = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]+)://")
+
+
+def register_scheme(scheme: str, opener: Callable) -> None:
+    """Register ``opener(uri, mode) -> file-like`` for ``scheme://``
+    URIs. Overrides fsspec for that scheme. Pass ``None`` to unregister.
+    """
+    if opener is None:
+        _SCHEMES.pop(scheme, None)
+    else:
+        _SCHEMES[scheme] = opener
+
+
+def uri_scheme(uri: str) -> str:
+    """Return the URI scheme, or '' for a plain local path.
+
+    Windows drive letters ('C://..') and other single-char schemes are
+    treated as local paths; 'file://' is normalized to ''.
+    """
+    m = _URI_RE.match(uri)
+    if m is None:
+        return ""
+    s = m.group(1).lower()
+    return "" if s == "file" else s
+
+
+def local_path(uri: str) -> str:
+    """Strip a 'file://' prefix; other URIs/paths pass through."""
+    return uri[7:] if uri.lower().startswith("file://") else uri
+
+
+def open_stream(uri: str, mode: str = "rb"):
+    """Open ``uri`` for reading or writing; returns a file-like object.
+
+    The single entry point all framework I/O goes through (reference:
+    dmlc ``Stream::Create``, used for model_in/model_dir and iterator
+    paths). Local paths open natively; ``scheme://`` URIs dispatch to a
+    registered opener or fsspec.
+    """
+    scheme = uri_scheme(uri)
+    if scheme == "":
+        path = local_path(uri)
+        if any(c in mode for c in "wa+"):
+            d = os.path.dirname(path)
+            if d and not os.path.isdir(d):
+                os.makedirs(d, exist_ok=True)
+        return builtins.open(path, mode)
+    if scheme in _SCHEMES:
+        return _SCHEMES[scheme](uri, mode)
+    try:
+        import fsspec
+        return fsspec.open(uri, mode).open()
+    except (ImportError, ValueError) as e:
+        raise IOError(
+            "open_stream: no handler for scheme '%s://' (uri=%r): %s. "
+            "Install fsspec (plus the %s filesystem package) or "
+            "register_scheme('%s', opener)." % (scheme, uri, e, scheme,
+                                                scheme))
+
+
+def stream_exists(uri: str) -> bool:
+    """True if ``uri`` names an existing file (local stat or a
+    successful remote open)."""
+    scheme = uri_scheme(uri)
+    if scheme == "":
+        return os.path.exists(local_path(uri))
+    try:
+        with open_stream(uri, "rb"):
+            return True
+    except (IOError, OSError):
+        return False
