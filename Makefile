@@ -48,7 +48,19 @@ bin/bin2rec: tools/bin2rec.cc src/io/binpage.h src/io/recordio.cc \
 		src/io/recordio.h | bin
 	$(CXX) $(CXXFLAGS) -o $@ tools/bin2rec.cc src/io/recordio.cc
 
+# compile-only smoke for the Matlab mex wrapper: no Matlab in CI, so a
+# stub mex.h + linker shims stand in for $(MATLAB)/extern (catches
+# syntax/type/symbol errors; a real build just swaps the include path)
+mex-smoke: lib/cxxnet_mex_smoke.so
+lib/cxxnet_mex_smoke.so: wrapper/matlab/cxxnet_mex.cpp \
+		wrapper/matlab/mex_stub/mex.h \
+		wrapper/matlab/mex_stub/mex_stub.cc \
+		wrapper/cxxnet_wrapper.h | lib
+	$(CXX) $(CXXFLAGS) -Iwrapper/matlab/mex_stub -shared -o $@ \
+		wrapper/matlab/cxxnet_mex.cpp \
+		wrapper/matlab/mex_stub/mex_stub.cc
+
 clean:
 	rm -rf lib bin
 
-.PHONY: all clean
+.PHONY: all clean mex-smoke

@@ -140,8 +140,16 @@ def measure_pipeline(batch: int = 256, rec_path: str = "/tmp/bench.rec",
 
 
 def main():
-    import sys
-    if "--pipeline" in sys.argv:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pipeline", action="store_true",
+                    help="end-to-end imgrec pipeline mode")
+    ap.add_argument("--model", choices=sorted(MODELS), default="alexnet")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="scanned steps (default: 200 alexnet, 50 others)")
+    ap.add_argument("--batch", type=int, default=None)
+    args = ap.parse_args()
+    if args.pipeline:
         e2e, duty, pure = measure_pipeline()
         print(json.dumps({
             "metric": "end-to-end images/sec (imgrec pipeline)",
@@ -151,16 +159,10 @@ def main():
             "pure_compute_images_per_sec": round(pure, 1),
         }))
         return
-    model = "alexnet"
-    if "--model" in sys.argv:
-        model = sys.argv[sys.argv.index("--model") + 1]
-    steps = 200 if model == "alexnet" else 50
-    if "--steps" in sys.argv:
-        steps = int(sys.argv[sys.argv.index("--steps") + 1])
-    batch = None
-    if "--batch" in sys.argv:
-        batch = int(sys.argv[sys.argv.index("--batch") + 1])
-    ips = measure(steps=steps, batch=batch, model=model)
+    model = args.model
+    steps = args.steps if args.steps is not None else (
+        200 if model == "alexnet" else 50)
+    ips = measure(steps=steps, batch=args.batch, model=model)
     # 'AlexNet' spelling keeps the canonical BENCH metric name stable
     # across rounds
     name = "AlexNet" if model == "alexnet" else model

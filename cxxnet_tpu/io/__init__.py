@@ -18,6 +18,7 @@ from typing import List, Sequence, Tuple
 from .data import DataBatch, DataInst, IIterator
 from .iter_batch import BatchAdapter, PrefetchIterator
 from .iter_csv import CSVIterator
+from .iter_libsvm import LibSVMIterator
 from .iter_mnist import MNISTIterator
 from .iter_mem import MemBufferIterator
 from .iter_img import ImageIterator
@@ -55,6 +56,10 @@ def create_iterator(cfg: Sequence[Tuple[str, str]],
             elif val == "csv":
                 assert it is None, "csv must be the base iterator"
                 it = CSVIterator()
+                is_instance_level = True
+            elif val == "libsvm":
+                assert it is None, "libsvm must be the base iterator"
+                it = LibSVMIterator()
                 is_instance_level = True
             elif val == "img":
                 assert it is None, "img must be the base iterator"

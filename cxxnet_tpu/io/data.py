@@ -91,3 +91,22 @@ def inst_array_shape(shape3: Tuple[int, int, int]) -> Tuple[int, ...]:
     if ch == 1 and y == 1:
         return (x,)
     return (y, x, ch)
+
+
+def resolve_data_shard(part_index: int, num_parts: int):
+    """Resolve a (part_index, num_parts) data shard for this process.
+
+    Explicit config wins; otherwise the distributed process rank is
+    auto-detected so every base iterator reads a disjoint shard under
+    multi-process dp — the PS_RANK sniffing of the reference
+    (iter_image_recordio-inl.hpp:169-173) applied uniformly.
+    """
+    if num_parts > 1:
+        return part_index, num_parts
+    try:
+        import jax
+        if jax.process_count() > 1:
+            return jax.process_index(), jax.process_count()
+    except Exception:
+        pass
+    return 0, 1

@@ -148,9 +148,20 @@ class AugmentAdapter(IIterator):
             d = np.asarray(self.base.value().data, np.float32)
             total = d.copy() if total is None else total + d
             cnt += 1
+        # under multi-process dp each rank saw only its disjoint shard:
+        # reduce sum+count globally so every rank normalizes with the
+        # SAME mean, and only root writes the cache (no write race)
+        from ..parallel import allreduce_host_sum, is_root, world_size
+        if world_size() > 1:
+            if total is None:
+                total = np.zeros((1,), np.float32)
+            total = allreduce_host_sum(total)
+            cnt = int(allreduce_host_sum(
+                np.asarray([cnt], np.float64))[0])
         self.meanimg = total / max(cnt, 1)
-        with open_stream(npy, "wb") as f:
-            np.save(f, self.meanimg)
+        if is_root():
+            with open_stream(npy, "wb") as f:
+                np.save(f, self.meanimg)
 
     def init(self) -> None:
         self.base.init()

@@ -10,7 +10,8 @@ from typing import Optional
 
 import numpy as np
 
-from .data import DataInst, IIterator, inst_array_shape, shape_from_conf
+from .data import (DataInst, IIterator, inst_array_shape,
+                   resolve_data_shard, shape_from_conf)
 from ..utils.stream import open_stream
 
 
@@ -21,7 +22,10 @@ class CSVIterator(IIterator):
         self.silent = 0
         self.label_width = 1
         self.shape = (0, 0, 0)
+        self.part_index = 0
+        self.num_parts = 1
         self.rows: Optional[np.ndarray] = None
+        self.indices: Optional[np.ndarray] = None
         self.idx = 0
         self.out: Optional[DataInst] = None
 
@@ -36,6 +40,10 @@ class CSVIterator(IIterator):
             self.label_width = int(val)
         if name == "input_shape":
             self.shape = shape_from_conf(val)
+        if name == "part_index":
+            self.part_index = int(val)
+        if name == "num_parts":
+            self.num_parts = int(val)
 
     def init(self) -> None:
         skip = 1 if self.has_header else 0
@@ -47,6 +55,10 @@ class CSVIterator(IIterator):
             raise ValueError(
                 "CSVIterator: row width %d != label_width %d + features %d"
                 % (self.rows.shape[1], self.label_width, nfeat))
+        # disjoint strided shard per distributed rank
+        pi, nparts = resolve_data_shard(self.part_index, self.num_parts)
+        self.indices = np.arange(self.rows.shape[0])[pi::nparts]
+        self.rows = self.rows[pi::nparts]
         if self.silent == 0:
             print("CSVIterator:filename=%s" % self.filename)
         self.idx = 0
@@ -66,7 +78,8 @@ class CSVIterator(IIterator):
         else:
             ch, y, x = self.shape
             data = feats.reshape(ch, y, x).transpose(1, 2, 0)  # -> NHWC inst
-        self.out = DataInst(index=self.idx, data=data, label=label)
+        self.out = DataInst(index=int(self.indices[self.idx]),
+                            data=data, label=label)
         self.idx += 1
         return True
 

@@ -14,7 +14,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from .data import DataInst, IIterator
+from .data import DataInst, IIterator, resolve_data_shard
 from ..utils.stream import open_stream
 
 
@@ -26,6 +26,8 @@ class ImageIterator(IIterator):
         self.shuffle = 0
         self.silent = 0
         self.seed = 0
+        self.part_index = 0
+        self.num_parts = 1
         self.rows: List[tuple] = []
         self.order: Optional[np.ndarray] = None
         self.idx = 0
@@ -44,6 +46,10 @@ class ImageIterator(IIterator):
             self.silent = int(val)
         if name == "seed_data":
             self.seed = int(val)
+        if name == "part_index":
+            self.part_index = int(val)
+        if name == "num_parts":
+            self.num_parts = int(val)
 
     def init(self) -> None:
         self.rows = []
@@ -58,6 +64,10 @@ class ImageIterator(IIterator):
                                    np.float32)
                 path = toks[1 + self.label_width]
                 self.rows.append((index, label, path))
+        # disjoint strided shard per distributed rank
+        pi, nparts = resolve_data_shard(self.part_index, self.num_parts)
+        if nparts > 1:
+            self.rows = self.rows[pi::nparts]
         self.order = np.arange(len(self.rows))
         if self.silent == 0:
             print("ImageIterator: %d images from %s"

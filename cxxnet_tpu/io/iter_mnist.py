@@ -19,7 +19,7 @@ from typing import Optional
 
 import numpy as np
 
-from .data import DataBatch, IIterator
+from .data import DataBatch, IIterator, resolve_data_shard
 
 
 class _ClosingGzip(gzip.GzipFile):
@@ -70,6 +70,8 @@ class MNISTIterator(IIterator):
         self.path_img = ""
         self.path_label = ""
         self.seed = self.kRandMagic
+        self.part_index = 0
+        self.num_parts = 1
         self.loc = 0
         self.out: Optional[DataBatch] = None
 
@@ -90,6 +92,10 @@ class MNISTIterator(IIterator):
             self.path_label = val
         if name == "seed_data":
             self.seed = self.kRandMagic + int(val)
+        if name == "part_index":
+            self.part_index = int(val)
+        if name == "num_parts":
+            self.num_parts = int(val)
 
     def init(self) -> None:
         assert self.batch_size > 0, "mnist iterator: batch_size not set"
@@ -101,6 +107,13 @@ class MNISTIterator(IIterator):
             rng = np.random.RandomState(self.seed)
             perm = rng.permutation(n)
             img, lab, inst = img[perm], lab[perm], inst[perm]
+        # disjoint strided shard per distributed rank (after the
+        # seed-deterministic shuffle so ranks agree on the permutation)
+        pi, nparts = resolve_data_shard(self.part_index, self.num_parts)
+        if nparts > 1:
+            img, lab, inst = img[pi::nparts], lab[pi::nparts], \
+                inst[pi::nparts]
+            n = img.shape[0]
         if self.input_flat:
             self.img = img.reshape(n, -1)
         else:

@@ -118,7 +118,11 @@ def RecordIOWriter(path: str, force_python: bool = False):
     # remote URIs go through the Python writer (open_stream); the
     # native C writer fopen()s local paths only
     if _lib is not None and not force_python and uri_scheme(path) == "":
-        return _NativeWriter(local_path(path))
+        p = local_path(path)
+        d = os.path.dirname(p)
+        if d and not os.path.isdir(d):   # match open_stream's mkdir
+            os.makedirs(d, exist_ok=True)
+        return _NativeWriter(p)
     return _PyWriter(path)
 
 

@@ -23,10 +23,10 @@ import numpy as np
 
 from .io import create_iterator
 from .nnet.trainer import NetTrainer
-from .parallel import init_distributed, is_root
+from .parallel import init_distributed, is_root, world_size
 from .utils.config import (parse_cli_overrides, parse_config_file,
                            split_sections)
-from .utils.stream import open_stream
+from .utils.stream import list_stream_dir, open_stream, uri_scheme
 
 _MODEL_RE = re.compile(r"^(\d{4})\.model\.npz$")
 
@@ -94,14 +94,16 @@ class LearnTask:
     # -- model files -----------------------------------------------------
 
     def _model_path(self, counter: int) -> str:
+        if uri_scheme(self.model_dir):
+            return "%s/%04d.model.npz" % (self.model_dir.rstrip("/"),
+                                          counter)
         return os.path.join(self.model_dir, "%04d.model.npz" % counter)
 
     def _sync_latest_model(self) -> Optional[str]:
-        """Find the newest snapshot in model_dir (cxxnet_main:180-202)."""
-        if not os.path.isdir(self.model_dir):
-            return None
+        """Find the newest snapshot in model_dir (cxxnet_main:180-202);
+        works for remote model_dir URIs via the stream layer."""
         best = None
-        for fn in os.listdir(self.model_dir):
+        for fn in list_stream_dir(self.model_dir):
             m = _MODEL_RE.match(fn)
             if m:
                 c = int(m.group(1))
@@ -119,6 +121,14 @@ class LearnTask:
             print("Usage: python -m cxxnet_tpu.main config.conf "
                   "[key=value ...]")
             return 1
+        # CPU-only local mode (example/multi-machine/launch.py): this
+        # environment preloads jax at interpreter start, so JAX_PLATFORMS
+        # in the env is read too late — honor it via jax.config before
+        # the backend initializes
+        ndev = os.environ.get("CXXNET_NUM_CPU_DEVICES")
+        if ndev:
+            from .parallel import force_virtual_cpu
+            force_virtual_cpu(int(ndev))
         init_distributed()
         cfg = parse_config_file(argv[0])
         cfg += parse_cli_overrides(argv[1:])
@@ -151,6 +161,20 @@ class LearnTask:
         all_iters: List[object] = []
         batch_cfg = [(k, v) for k, v in global_cfg
                      if k in ("batch_size", "input_shape", "label_width")]
+        # multi-process dp: config batch_size is GLOBAL (doc/global.md);
+        # each rank's iterator produces its 1/world_size local shard,
+        # which the trainer assembles into the global batch
+        # (make_array_from_process_local_data). Rank-disjoint DATA comes
+        # from the iterators' own part_index/num_parts sharding.
+        nproc = world_size()
+        if nproc > 1:
+            def _local_bs(v: str) -> str:
+                assert int(v) % nproc == 0, \
+                    "batch_size %s must divide evenly across %d " \
+                    "processes" % (v, nproc)
+                return str(int(v) // nproc)
+            batch_cfg = [(k, _local_bs(v) if k == "batch_size" else v)
+                         for k, v in batch_cfg]
         for b in blocks:
             it = create_iterator(b["cfg"], batch_cfg)
             it.init()
@@ -239,6 +263,12 @@ class LearnTask:
 
     def _task_predict(self, trainer, itr) -> int:
         assert itr is not None, "pred requires an iterator"
+        # pred/extract are single-process tasks (as in the reference
+        # CLI): under multi-process dp each rank would see only its
+        # data shard and they would race on the output file
+        assert world_size() == 1, \
+            "task=pred must run single-process (launch without " \
+            "CXXNET_COORDINATOR)"
         with open_stream(self.name_pred, "w") as f:
             for batch in itr:
                 for v in trainer.predict(batch):
@@ -248,6 +278,8 @@ class LearnTask:
 
     def _task_extract(self, trainer, itr) -> int:
         assert itr is not None, "extract requires an iterator"
+        assert world_size() == 1, \
+            "task=extract_feature must run single-process"
         node = self.extract_node_name
         with open_stream(self.name_pred, "w") as f:
             for batch in itr:

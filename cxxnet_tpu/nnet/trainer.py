@@ -44,7 +44,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..graph import NetGraph
 from ..io.data import DataBatch
-from ..layers import as_mat
 from ..parallel import (batch_sharding, make_mesh, opt_state_sharding,
                         param_sharding, replicated)
 from ..updater import create_updater
@@ -339,15 +338,33 @@ class NetTrainer:
 
     # -- batch plumbing --------------------------------------------------
 
+    def _local_batch_size(self, batch: DataBatch) -> int:
+        """Rows this process contributes. For an already-global array
+        (placed by the prefetch transform) that is 1/world_size of its
+        leading dim; for host arrays it is the array's own size."""
+        n = batch.batch_size
+        if (jax.process_count() > 1 and isinstance(batch.data, jax.Array)
+                and batch.data.sharding == self._b_shard):
+            n //= jax.process_count()
+        return n
+
     def _mask(self, batch: DataBatch) -> np.ndarray:
-        m = np.ones((batch.batch_size,), np.float32)
+        n = self._local_batch_size(batch)
+        m = np.ones((n,), np.float32)
         if batch.num_batch_padd:
-            m[batch.batch_size - batch.num_batch_padd:] = 0.0
+            m[n - batch.num_batch_padd:] = 0.0
         return m
 
     def _label_fields(self, label: np.ndarray, nvalid: int):
         return {name: label[:nvalid, a:b]
                 for name, a, b in self._label_slices}
+
+    def _host_label(self, batch: DataBatch) -> np.ndarray:
+        """This process's label rows as float32 numpy (device labels
+        placed by the prefetch transform come back via local shards)."""
+        if isinstance(batch.label, jax.Array):
+            return self._local_rows(batch.label).astype(np.float32)
+        return np.asarray(batch.label, np.float32)
 
     def _put_batch_array(self, x) -> jnp.ndarray:
         if isinstance(x, jax.Array) and x.sharding == self._b_shard:
@@ -355,6 +372,12 @@ class NetTrainer:
         arr = np.asarray(x)
         if arr.dtype != np.uint8:         # u8 pixels ship raw (1/4 bytes)
             arr = np.asarray(arr, np.float32)
+        if jax.process_count() > 1:
+            # multi-process dp: each rank contributes its local shard of
+            # the global batch (config batch_size is GLOBAL, split across
+            # ranks like the reference splits across PS workers)
+            return jax.make_array_from_process_local_data(
+                self._b_shard, arr)
         return jax.device_put(arr, self._b_shard)
 
     def _device_batch(self, batch: DataBatch):
@@ -377,6 +400,24 @@ class NetTrainer:
 
     def _device_extra(self, batch: DataBatch):
         return tuple(self._put_batch_array(e) for e in batch.extra_data)
+
+    def _local_rows(self, arr, flatten: bool = True) -> np.ndarray:
+        """Fetch this process's rows of a batch-sharded output.
+
+        Single-process: the whole array. Multi-process dp: concatenate
+        the addressable shards in global row order, which is exactly the
+        order of this rank's local input rows
+        (make_array_from_process_local_data splits the local batch over
+        local devices in ascending mesh position). ``flatten`` returns
+        the as_mat 2-D view."""
+        if jax.process_count() == 1:
+            out = np.asarray(arr)
+        else:
+            shards = sorted(arr.addressable_shards,
+                            key=lambda s: s.index[0].start or 0)
+            out = np.concatenate([np.asarray(s.data) for s in shards],
+                                 axis=0)
+        return out.reshape(out.shape[0], -1) if flatten else out
 
     # -- public API ------------------------------------------------------
 
@@ -401,11 +442,11 @@ class NetTrainer:
             self.sample_counter = 0
             self.update_counter += 1
         if self.eval_train and self._metrics.evals:
-            nvalid = batch.batch_size - batch.num_batch_padd
-            pred_np = [np.asarray(as_mat(p))[:nvalid] for p in preds]
+            nvalid = self._local_batch_size(batch) - batch.num_batch_padd
+            pred_np = [self._local_rows(p)[:nvalid] for p in preds]
             self._train_metrics.add_eval(
-                pred_np, self._label_fields(
-                    np.asarray(batch.label, np.float32), nvalid))
+                pred_np, self._label_fields(self._host_label(batch),
+                                            nvalid))
 
     def run_steps(self, batch: DataBatch, n_steps: int) -> None:
         """Run n_steps full update steps on one resident batch in a
@@ -434,49 +475,49 @@ class NetTrainer:
         self._metrics.clear()
         nodes_wanted = tuple(self._metric_nodes)
         for batch in data_iter:
-            data = jax.device_put(np.asarray(batch.data, np.float32),
-                                  self._b_shard)
-            vals = self._pred_step(self.params, self.net_state, data,
+            # same input path as training: uint8 pixels ship raw (1/4
+            # the H2D bytes) and pre-placed prefetch batches pass
+            # through (reference evaluates through the training pipeline,
+            # nnet_impl-inl.hpp:241-276)
+            vals = self._pred_step(self.params, self.net_state,
+                                   self._put_batch_array(batch.data),
                                    self._put_batch_array(
                                        self._mask(batch)),
                                    self._device_extra(batch),
                                    nodes_wanted=nodes_wanted)
-            nvalid = batch.batch_size - batch.num_batch_padd
-            pred_np = [np.asarray(as_mat(v))[:nvalid] for v in vals]
+            nvalid = self._local_batch_size(batch) - batch.num_batch_padd
+            pred_np = [self._local_rows(v)[:nvalid] for v in vals]
             self._metrics.add_eval(
-                pred_np, self._label_fields(
-                    np.asarray(batch.label, np.float32), nvalid))
+                pred_np, self._label_fields(self._host_label(batch),
+                                            nvalid))
         return self._metrics.print_str(name)
 
     def predict(self, batch: DataBatch) -> np.ndarray:
         """argmax class (or raw scalar) per row of the top node
         (nnet_impl-inl.hpp:317-330)."""
         top = self.graph.num_nodes - 1
-        data = jax.device_put(np.asarray(batch.data, np.float32),
-                              self._b_shard)
-        (val,) = self._pred_step(self.params, self.net_state, data,
+        (val,) = self._pred_step(self.params, self.net_state,
+                                 self._put_batch_array(batch.data),
                                  self._put_batch_array(
                                      self._mask(batch)),
                                  self._device_extra(batch),
                                  nodes_wanted=(top,))
-        m = np.asarray(as_mat(val))
-        nvalid = batch.batch_size - batch.num_batch_padd
-        m = m[:nvalid]
+        nvalid = self._local_batch_size(batch) - batch.num_batch_padd
+        m = self._local_rows(val)[:nvalid]
         if m.shape[1] == 1:
             return m[:, 0]
         return np.argmax(m, axis=1).astype(np.float32)
 
     def extract_feature(self, batch: DataBatch, node: str) -> np.ndarray:
         ni = self.net.node_index_by_name(node)
-        data = jax.device_put(np.asarray(batch.data, np.float32),
-                              self._b_shard)
-        (val,) = self._pred_step(self.params, self.net_state, data,
+        (val,) = self._pred_step(self.params, self.net_state,
+                                 self._put_batch_array(batch.data),
                                  self._put_batch_array(
                                      self._mask(batch)),
                                  self._device_extra(batch),
                                  nodes_wanted=(ni,))
-        nvalid = batch.batch_size - batch.num_batch_padd
-        return np.asarray(val)[:nvalid]
+        nvalid = self._local_batch_size(batch) - batch.num_batch_padd
+        return self._local_rows(val, flatten=False)[:nvalid]
 
     # -- weights ---------------------------------------------------------
 

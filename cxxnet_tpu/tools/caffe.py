@@ -1,0 +1,157 @@
+"""Caffe ``.caffemodel`` importer: a minimal protobuf wire-format reader.
+
+The reference's caffe converter links libcaffe and copies InnerProduct /
+Convolution blobs into the net by layer name
+(``/root/reference/tools/caffe_converter/convert.cpp:29-187``). Here the
+binary NetParameter is decoded directly — no protobuf/caffe dependency —
+and the blobs are exposed as a torch-style ``{name.weight, name.bias}``
+dict that ``convert.load_source``/``convert.convert`` map onto a net by
+layer name, exactly like the torch import path.
+
+Wire format essentials (proto2):
+  NetParameter: name=1, layers=2 (repeated V1LayerParameter),
+                layer=100 (repeated LayerParameter)
+  V1LayerParameter: name=4, type=5(enum), blobs=6
+  LayerParameter:   name=1, type=2(string), blobs=7
+  BlobProto: num=1 channels=2 height=3 width=4 (legacy 4-D),
+             data=5 (repeated float, packed or not),
+             shape=7 (BlobShape: dim=1 repeated int64)
+
+Caffe blob layouts match torch's: conv (out, in/group, kh, kw), fc
+(out, in) — so the existing name-mapped layout conversion in
+``convert.py`` applies unchanged.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, Tuple
+
+import numpy as np
+
+from ..utils.stream import open_stream
+
+
+# ------------------------------------------------------------ wire level
+
+def _read_varint(buf: memoryview, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("caffe import: truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("caffe import: varint too long")
+
+
+def _fields(buf: memoryview) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) over a message buffer.
+
+    value: int for wire 0/1/5 (raw bits for the fixed types), memoryview
+    for wire 2.
+    """
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError("caffe import: unsupported wire type %d"
+                             % wire)
+        if pos > n:
+            raise ValueError("caffe import: truncated field %d" % field)
+        yield field, wire, val
+
+
+# ------------------------------------------------------------ messages
+
+def _parse_blob(buf: memoryview) -> np.ndarray:
+    legacy = {}
+    dims: List[int] = []
+    floats: List[np.ndarray] = []
+    for field, wire, val in _fields(buf):
+        if field in (1, 2, 3, 4) and wire == 0:
+            legacy[field] = val
+        elif field == 5:                      # data
+            if wire == 2:                     # packed floats
+                floats.append(np.frombuffer(bytes(val), "<f4"))
+            elif wire == 5:                   # unpacked single float
+                floats.append(np.frombuffer(bytes(val), "<f4"))
+        elif field == 7 and wire == 2:        # BlobShape{dim=1 varint}
+            for f2, w2, v2 in _fields(val):
+                if f2 == 1:
+                    if w2 == 0:
+                        dims.append(int(v2))
+                    elif w2 == 2:             # packed int64 dims
+                        p = 0
+                        while p < len(v2):
+                            d, p = _read_varint(v2, p)
+                            dims.append(int(d))
+        elif field == 8 and wire == 2:        # double_data
+            floats.append(np.frombuffer(bytes(val), "<f8")
+                          .astype(np.float32))
+    data = (np.concatenate(floats) if floats
+            else np.zeros((0,), np.float32))
+    if not dims and legacy:
+        dims = [legacy.get(i, 1) for i in (1, 2, 3, 4)]
+        # drop leading singleton dims of the legacy 4-D shape
+        while len(dims) > 1 and dims[0] == 1:
+            dims = dims[1:]
+    if dims and int(np.prod(dims)) == data.size:
+        return data.reshape(dims)
+    return data
+
+
+def _parse_layer(buf: memoryview, v1: bool):
+    name = ""
+    blobs: List[np.ndarray] = []
+    f_name = 4 if v1 else 1
+    f_blobs = 6 if v1 else 7
+    for field, wire, val in _fields(buf):
+        if field == f_name and wire == 2:
+            name = bytes(val).decode("utf-8", "replace")
+        elif field == f_blobs and wire == 2:
+            blobs.append(_parse_blob(val))
+    return name, blobs
+
+
+def load_caffe(path: str) -> Dict[str, np.ndarray]:
+    """Read a .caffemodel into ``{layer.weight, layer.bias}`` arrays.
+
+    Layers with no blobs (relu, pooling, data...) are skipped, like the
+    reference's dynamic_cast chain only matching InnerProduct/
+    Convolution (convert.cpp:75-129).
+    """
+    with open_stream(path, "rb") as f:
+        raw = f.read()
+    out: Dict[str, np.ndarray] = {}
+    for field, wire, val in _fields(memoryview(raw)):
+        if field in (2, 100) and wire == 2:
+            name, blobs = _parse_layer(val, v1=(field == 2))
+            if not name or not blobs:
+                continue
+            out[name + ".weight"] = blobs[0]
+            if len(blobs) > 1:
+                out[name + ".bias"] = blobs[1]
+    if not out:
+        raise ValueError(
+            "caffe import: no parameterized layers found in %r" % path)
+    return out

@@ -33,10 +33,14 @@ from ..utils.stream import open_stream
 
 
 def load_source(path: str) -> Dict[str, np.ndarray]:
-    """Load a torch state dict (.pth/.pt) or a .npz into flat arrays."""
+    """Load a torch state dict (.pth/.pt), .npz, or .caffemodel into
+    flat ``{name.weight, name.bias}`` arrays."""
     if path.endswith(".npz"):
         with open_stream(path, "rb") as f:
             return dict(np.load(f))
+    if path.endswith(".caffemodel"):
+        from .caffe import load_caffe
+        return load_caffe(path)
     import torch
     sd = torch.load(path, map_location="cpu", weights_only=True)
     if hasattr(sd, "state_dict"):
@@ -113,8 +117,8 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) < 3:
         print("Usage: python -m cxxnet_tpu.tools.convert "
-              "<src.pth|src.npz> <net.conf> <out.model.npz> "
-              "[name_map.txt]")
+              "<src.pth|src.npz|src.caffemodel> <net.conf> "
+              "<out.model.npz> [name_map.txt]")
         return 1
     return convert(argv[0], argv[1], argv[2],
                    argv[3] if len(argv) > 3 else None)

@@ -1,0 +1,45 @@
+"""Pure-Python im2bin: pack an image list into a BinaryPage archive.
+
+Fallback for the native ``bin/im2bin`` (``tools/im2bin.cc``; reference
+``/root/reference/tools/im2bin.cpp``): reads ``index label... path``
+rows and appends each image file's raw bytes to a page archive readable
+by the imgbin iterator.
+
+Usage: python -m cxxnet_tpu.tools.im2bin <list> <image_root> <out.bin>
+"""
+
+import sys
+
+from ..io.binpage import PageWriter
+from ..utils.stream import open_stream
+
+
+def im2bin(list_file: str, image_root: str, out_bin: str,
+           label_width: int = 1) -> int:
+    n = 0
+    w = PageWriter(out_bin)
+    with open_stream(list_file, "r") as f:
+        for line in f:
+            toks = line.split()
+            if not toks:
+                continue
+            path = image_root + toks[1 + label_width]
+            with open_stream(path, "rb") as img:
+                w.write(img.read())
+            n += 1
+    w.close()
+    print("im2bin: packed %d images -> %s" % (n, out_bin))
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 3:
+        print(__doc__)
+        return 1
+    return im2bin(argv[0], argv[1], argv[2],
+                  int(argv[3]) if len(argv) > 3 else 1)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,6 +88,35 @@ def open_stream(uri: str, mode: str = "rb"):
                                                 scheme))
 
 
+def list_stream_dir(uri: str):
+    """List entry basenames of a directory URI; [] if it doesn't exist.
+
+    Local paths use os.listdir; scheme:// URIs use the fsspec
+    filesystem (registered mock schemes without a lister return []).
+    Used by continue=1 resume to find the newest snapshot in a possibly
+    remote model_dir (reference cxxnet_main.cpp:180-202).
+    """
+    scheme = uri_scheme(uri)
+    if scheme == "":
+        path = local_path(uri)
+        if not os.path.isdir(path):
+            return []
+        return os.listdir(path)
+    try:
+        import fsspec
+        fs, root = fsspec.core.url_to_fs(uri)
+        return [p.rstrip("/").rsplit("/", 1)[-1] for p in fs.ls(root)]
+    except FileNotFoundError:
+        return []
+    except (ImportError, ValueError):
+        # no fsspec / unregistered scheme: treat as an empty directory
+        # (registered mock schemes have no listing hook). Transient
+        # remote errors (auth, network: other OSErrors) PROPAGATE —
+        # mapping them to [] would make continue=1 silently restart
+        # from round 0 and overwrite snapshots.
+        return []
+
+
 def stream_exists(uri: str) -> bool:
     """True if ``uri`` names an existing file (local stat or a
     successful remote open)."""

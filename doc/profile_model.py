@@ -1,0 +1,145 @@
+"""HLO-level device profile for any model in the zoo.
+
+Traces ``NetTrainer.run_steps`` with the JAX profiler, then walks the
+xplane with ``jax.profiler.ProfileData`` and aggregates device op
+self-times by HLO category — the hlo_stats methodology used for the
+AlexNet profile in perf_profile.md (reference's written-profile promise:
+doc/debug_perf.md:3-21).
+
+Usage: python doc/profile_model.py [model] [batch] [steps]
+"""
+
+import os
+import re
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+_OPCODE_RE = re.compile(r"=\s+\S+\s+([\w-]+)\(")
+_KIND_RE = re.compile(r"kind=k(\w+)")
+
+
+def categorize(name: str) -> str:
+    """Category from the HLO text of a sync TensorCore op."""
+    n = name.lower()
+    m = _OPCODE_RE.search(name)
+    op = m.group(1) if m else name.split(" ")[0].lstrip("%").split(".")[0]
+    if "convolution" in n:
+        return "convolution"
+    if op == "fusion":
+        k = _KIND_RE.search(name)
+        return "fusion:%s" % (k.group(1) if k else "loop")
+    if op in ("dot", "custom-call"):
+        return op
+    if "select-and-scatter" in op:
+        return "select-and-scatter (pool bwd)"
+    if "reduce-window" in op:
+        return "reduce-window (pool fwd)"
+    if op in ("all-reduce", "all-gather", "reduce-scatter",
+              "collective-permute"):
+        return "collective"
+    if op in ("copy", "transpose", "bitcast", "reshape", "slice",
+              "dynamic-slice", "dynamic-update-slice", "concatenate",
+              "pad"):
+        return "copy/format"
+    return op
+
+
+def profile(model: str = "inception_bn", batch: int = 0,
+            steps: int = 30, logdir: str = "/tmp/cxxnet_profile"):
+    import cxxnet_tpu.models as zoo
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config
+    from bench import MODELS
+
+    default_batch, size = MODELS[model]
+    batch = batch or default_batch
+    builder = getattr(zoo, model)
+    t = NetTrainer(parse_config(builder(nclass=1000, batch_size=batch,
+                                        image_size=size))
+                   + [("eval_train", "0"), ("dtype", "bfloat16")])
+    t.init_model()
+    rng = np.random.RandomState(0)
+    b = DataBatch(
+        data=t._put_batch_array(
+            rng.rand(batch, size, size, 3).astype(np.float32)),
+        label=t._put_batch_array(
+            rng.randint(0, 1000, (batch, 1)).astype(np.float32)))
+
+    t.run_steps(b, steps)        # compile + warm
+    _ = t.last_loss
+
+    t0 = time.perf_counter()
+    t.run_steps(b, steps)
+    _ = t.last_loss
+    wall_ms = (time.perf_counter() - t0) / steps * 1e3
+
+    with jax.profiler.trace(logdir):
+        t.run_steps(b, steps)
+        _ = t.last_loss
+
+    # newest .xplane.pb under logdir
+    paths = []
+    for root, _, files in os.walk(logdir):
+        for f in files:
+            if f.endswith(".xplane.pb"):
+                p = os.path.join(root, f)
+                paths.append((os.path.getmtime(p), p))
+    assert paths, "no xplane produced under %s" % logdir
+    xplane = sorted(paths)[-1][1]
+
+    from jax.profiler import ProfileData
+    pd = ProfileData.from_file(xplane)
+    # sync TensorCore ops only ("XLA Ops" line; device_duration is the
+    # serialized busy time). "Async XLA Ops" (DMA copy-start etc.)
+    # overlap with compute and are totalled separately.
+    op_self = defaultdict(float)
+    async_total = 0.0
+    for plane in pd.planes:
+        for line in plane.lines:
+            if line.name == "XLA Ops":
+                for ev in line.events:
+                    dur = dict(ev.stats).get("device_duration_ps")
+                    ms = (dur / 1e9) if dur is not None \
+                        else ev.duration_ns / 1e6
+                    op_self[ev.name] += ms
+            elif line.name == "Async XLA Ops":
+                for ev in line.events:
+                    dur = dict(ev.stats).get("device_duration_ps")
+                    async_total += (dur / 1e9) if dur is not None \
+                        else ev.duration_ns / 1e6
+
+    cat = defaultdict(float)
+    for name, ms in op_self.items():
+        cat[categorize(name)] += ms
+    busy = sum(cat.values())
+
+    print("== %s  batch %d  (%d-step scan) ==" % (model, batch, steps))
+    print("wall: %.2f ms/step  -> %.0f img/s" % (wall_ms,
+                                                 batch / wall_ms * 1e3))
+    print("device busy (sum sync-op self-times): %.2f ms/step"
+          % (busy / steps))
+    print("async (overlapped DMA) in-flight total: %.2f ms/step"
+          % (async_total / steps))
+    print("\nby category (%% of device busy):")
+    for k, v in sorted(cat.items(), key=lambda kv: -kv[1]):
+        print("  %-32s %6.2f ms/step  %5.1f%%"
+              % (k, v / steps, 100 * v / busy))
+    print("\ntop 25 ops (ms/step):")
+    for name, ms in sorted(op_self.items(), key=lambda kv: -kv[1])[:25]:
+        print("  %8.3f  %s" % (ms / steps, name[:100]))
+    return wall_ms
+
+
+if __name__ == "__main__":
+    profile(sys.argv[1] if len(sys.argv) > 1 else "inception_bn",
+            int(sys.argv[2]) if len(sys.argv) > 2 else 0,
+            int(sys.argv[3]) if len(sys.argv) > 3 else 30)

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Local multi-process launcher — the ps-lite local-mode equivalent.
+
+The reference launches distributed training with a tracker script that
+starts n workers + servers (``/root/reference/example/multi-machine/
+run.sh:12-18``, dmlc_mpi.py / ps-lite local.sh). The TPU rebuild needs
+no separate servers (the PS collapses into XLA collectives), so the
+launcher spawns n CLI worker processes on this machine, wires the
+``CXXNET_*`` bring-up env (coordinator address, world size, rank), and
+streams their rank-prefixed output. Each rank auto-shards the data
+(part_index/num_parts autodetect in every base iterator) and rank 0
+alone writes snapshots/logs.
+
+Usage:
+  python launch.py -n 2 <config.conf> [key=value overrides...]
+
+On a real multi-host TPU pod, run the same CLI on every host with
+CXXNET_COORDINATOR=<host0:port> CXXNET_NUM_PROCESSES=<n>
+CXXNET_PROCESS_ID=<rank> instead (see doc/multi-device.md).
+"""
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def stream(rank: int, pipe) -> None:
+    for line in iter(pipe.readline, b""):
+        sys.stdout.write("[%d] %s" % (rank,
+                                      line.decode(errors="replace")))
+        sys.stdout.flush()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="spawn n local cxxnet_tpu training processes")
+    ap.add_argument("-n", "--nworker", type=int, default=2)
+    ap.add_argument("--devices-per-worker", type=int, default=0,
+                    help="virtual CPU devices per process (0 = "
+                         "platform default; set >0 for CPU-only runs)")
+    ap.add_argument("config")
+    ap.add_argument("overrides", nargs="*")
+    args = ap.parse_args()
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    port = free_port()
+    procs = []
+    threads = []
+    for r in range(args.nworker):
+        env = dict(os.environ)
+        env["CXXNET_COORDINATOR"] = "127.0.0.1:%d" % port
+        env["CXXNET_NUM_PROCESSES"] = str(args.nworker)
+        env["CXXNET_PROCESS_ID"] = str(r)
+        env["PYTHONPATH"] = repo + (
+            ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        if args.devices_per_worker > 0:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["CXXNET_NUM_CPU_DEVICES"] = str(args.devices_per_worker)
+        p = subprocess.Popen(
+            [sys.executable, "-m", "cxxnet_tpu.main", args.config]
+            + args.overrides,
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        procs.append(p)
+        t = threading.Thread(target=stream, args=(r, p.stdout),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+
+    rc = 0
+    try:
+        for r, p in enumerate(procs):
+            p.wait()
+            if p.returncode != 0:
+                print("launch: rank %d exited with %d"
+                      % (r, p.returncode))
+                rc = p.returncode
+    except KeyboardInterrupt:
+        rc = 130
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for t in threads:
+            t.join(timeout=5)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Partition an image list into per-rank shards (+ optional imgbin pack).
+
+The reference splits train.lst into contiguous chunks and runs im2bin
+per chunk (``/root/reference/example/multi-machine/partition.sh:1-17``,
+``tools/imgbin-partition-maker.py``). Same here:
+
+  python partition.py train.lst 4                 # tr_0.lst .. tr_3.lst
+  python partition.py train.lst 4 --image-root ./ --pack
+
+--pack runs the repo's im2bin (native bin/im2bin if built, else the
+Python fallback) producing tr_<i>.bin next to each list. Point each
+rank's config at its shard pair, or list every shard in one config
+(``image_list``/``image_bin`` space-separated) and let the imgbin
+iterator's part_index/num_parts autodetect pick per rank.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("list_file")
+    ap.add_argument("nparts", type=int)
+    ap.add_argument("--prefix", default="tr_")
+    ap.add_argument("--image-root", default="./")
+    ap.add_argument("--pack", action="store_true",
+                    help="run im2bin on each shard list")
+    args = ap.parse_args()
+
+    with open(args.list_file) as f:
+        rows = [ln for ln in f if ln.strip()]
+    n = len(rows)
+    assert args.nparts >= 1
+    shards = []
+    for i in range(args.nparts):
+        lo = n * i // args.nparts
+        hi = n * (i + 1) // args.nparts
+        lst = "%s%d.lst" % (args.prefix, i)
+        with open(lst, "w") as f:
+            f.writelines(rows[lo:hi])
+        shards.append(lst)
+        print("%s: %d rows" % (lst, hi - lo))
+
+    if args.pack:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        native = os.path.join(repo, "bin", "im2bin")
+        for lst in shards:
+            out = lst[:-4] + ".bin"
+            if os.path.exists(native):
+                cmd = [native, lst, args.image_root, out]
+            else:
+                cmd = [sys.executable, "-m", "cxxnet_tpu.tools.im2bin",
+                       lst, args.image_root, out]
+            print("+ " + " ".join(cmd))
+            subprocess.run(cmd, check=True,
+                           env=dict(os.environ,
+                                    PYTHONPATH=repo + (
+                                        ":" + os.environ["PYTHONPATH"]
+                                        if os.environ.get("PYTHONPATH")
+                                        else "")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

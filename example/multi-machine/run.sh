@@ -1,0 +1,31 @@
+#!/bin/bash
+# Distributed-training example: n local worker processes over localhost
+# — the ps-lite local-mode analogue of the reference's
+# example/multi-machine/run.sh (which drove dmlc_mpi.py / local.sh).
+#
+#   ./run.sh [nworker] [config] [key=value overrides...]
+#
+# Uses the MNIST example data (downloaded, or synthesized without
+# network). Each rank reads a disjoint shard of the training set
+# (iterator part_index/num_parts autodetect), the gradient all-reduce
+# spans both processes, and only rank 0 writes snapshots into ./models.
+set -e
+cd "$(dirname "$0")"
+
+NWORKER="${1:-2}"
+CONFIG="${2:-MNIST.conf}"          # resolved inside example/MNIST
+shift || true
+shift || true
+
+python ../MNIST/get_data.py
+mkdir -p models
+
+# config data paths are relative to example/MNIST; run the workers
+# there. --devices-per-worker 1: CPU local mode; drop it to let every
+# process claim its own accelerator (one process per TPU host in a
+# real pod).
+LAUNCH="$(pwd)/launch.py"
+MODELS="$(pwd)/models"
+cd ../MNIST
+python "$LAUNCH" -n "$NWORKER" --devices-per-worker 1 "$CONFIG" \
+    model_dir="$MODELS" "$@"

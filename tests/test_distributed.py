@@ -150,3 +150,333 @@ def test_two_process_bringup(tmp_path):
 
     # root-only save: the file exists exactly once, written by rank 0
     assert (tmp_path / "root.model").exists()
+
+
+# ---------------------------------------------------------------------------
+# Cross-process TRAINING equivalence: dp spanning 2 OS processes (x2
+# virtual devices each) must produce the same parameters as the same
+# training on 1 process x 4 devices — the rabit-mode training guarantee
+# (example/multi-machine/run.sh:12-18). Includes a mid-run root-only
+# snapshot + resume across the process boundary.
+# ---------------------------------------------------------------------------
+
+TRAIN_CONF = """
+netconfig = start
+layer[0->1] = fullc:fc1
+  nhidden = 16
+layer[1->2] = relu
+layer[2->3] = fullc:fc2
+  nhidden = 4
+layer[3->3] = softmax
+netconfig = end
+input_shape = 1,1,10
+batch_size = 8
+eta = 0.2
+momentum = 0.9
+random_type = gaussian
+init_sigma = 0.1
+seed = 11
+eval_train = 0
+"""
+
+TRAIN_BODY = r"""
+import numpy as np
+
+def make_data():
+    rng = np.random.RandomState(42)
+    X = rng.rand(48, 10).astype(np.float32)
+    y = (X @ rng.randn(10, 4)).argmax(1).astype(np.float32)
+    return X, y[:, None]
+
+def train(t, workdir, lo, hi, barrier):
+    from cxxnet_tpu.io.data import DataBatch
+    X, y = make_data()
+    mid = workdir + "/mid.model.npz"
+    for step in range(6):
+        if step == 3:
+            # mid-run snapshot: root writes, everyone resumes from it
+            from cxxnet_tpu.parallel import is_root, allreduce_host_sum
+            if is_root():
+                t.save_model(mid)
+            barrier()
+            t.load_model(mid)
+        gb = slice(step * 8, (step + 1) * 8)
+        t.update(DataBatch(data=X[gb][lo:hi], label=y[gb][lo:hi]))
+    return {("%s/%s" % (lk, tag)): np.asarray(w)
+            for lk, pt in t.params.items() for tag, w in pt.items()}
+"""
+
+TRAIN_WORKER = r"""
+import os, sys
+import numpy as np
+sys.path.insert(0, %(repo)r)
+
+from cxxnet_tpu.parallel import force_virtual_cpu
+force_virtual_cpu(2)                       # 2 local devices per process
+from cxxnet_tpu.parallel import init_distributed
+init_distributed()                         # before other jax API
+
+import jax
+assert jax.process_count() == 2 and len(jax.devices()) == 4
+
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config
+from cxxnet_tpu.parallel import rank, is_root, allreduce_host_sum
+
+%(body)s
+
+workdir = os.environ["CXXNET_TEST_WORKDIR"]
+with open(workdir + "/train.conf") as f:
+    t = NetTrainer(parse_config(f.read()))
+t.init_model()
+r = rank()
+barrier = lambda: allreduce_host_sum(np.zeros(1))
+# rank's half of each global batch of 8
+params = train(t, workdir, r * 4, (r + 1) * 4, barrier)
+if is_root():
+    np.savez(workdir + "/mp_final.npz", **params)
+print("TRAINWORKER%%d OK loss=%%.6f" %% (r, t.last_loss))
+"""
+
+TRAIN_SINGLE = r"""
+import os, sys
+import numpy as np
+sys.path.insert(0, %(repo)r)
+
+from cxxnet_tpu.parallel import force_virtual_cpu
+force_virtual_cpu(4)                       # same 4-device topology
+
+import jax
+assert len(jax.devices()) == 4
+
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config
+
+%(body)s
+
+workdir = os.environ["CXXNET_TEST_WORKDIR"]
+with open(workdir + "/train.conf") as f:
+    t = NetTrainer(parse_config(f.read()))
+t.init_model()
+params = train(t, workdir, 0, 8, lambda: None)
+np.savez(workdir + "/sp_final.npz", **params)
+print("SINGLE OK loss=%%.6f" %% t.last_loss)
+"""
+
+
+def test_cross_process_training_equivalence(tmp_path):
+    (tmp_path / "train.conf").write_text(TRAIN_CONF)
+
+    # --- 2 processes x 2 devices, with mid-run snapshot + resume
+    script = str(tmp_path / "train_worker.py")
+    with open(script, "w") as f:
+        f.write(TRAIN_WORKER % {"repo": REPO, "body": TRAIN_BODY})
+    port = _free_port()
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "CXXNET_COORDINATOR": "127.0.0.1:%d" % port,
+            "CXXNET_NUM_PROCESSES": "2",
+            "CXXNET_PROCESS_ID": str(r),
+            "CXXNET_TEST_WORKDIR": str(tmp_path),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    try:
+        for r, p in enumerate(procs):
+            out, _ = p.communicate(timeout=300)
+            txt = out.decode(errors="replace")
+            assert p.returncode == 0, "rank %d failed:\n%s" % (r, txt)
+            assert ("TRAINWORKER%d OK" % r) in txt, txt
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+
+    # the mid-run snapshot was written by root during the 2-process run
+    # (checked BEFORE the single-process run, which also snapshots)
+    assert (tmp_path / "mid.model.npz").exists()
+
+    # --- 1 process x 4 devices, same data/seed/schedule
+    script1 = str(tmp_path / "train_single.py")
+    with open(script1, "w") as f:
+        f.write(TRAIN_SINGLE % {"repo": REPO, "body": TRAIN_BODY})
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CXXNET_TEST_WORKDIR"] = str(tmp_path)
+    env.pop("CXXNET_COORDINATOR", None)
+    out = subprocess.run([sys.executable, script1], env=env,
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, timeout=300)
+    assert out.returncode == 0, out.stdout.decode(errors="replace")
+
+    # --- final parameters match across the process boundary
+    mp = np.load(tmp_path / "mp_final.npz")
+    sp = np.load(tmp_path / "sp_final.npz")
+    assert set(mp.files) == set(sp.files)
+    for k in mp.files:
+        np.testing.assert_allclose(
+            mp[k], sp[k], rtol=2e-6, atol=1e-7,
+            err_msg="param %s diverged across process boundary" % k)
+
+
+# ---------------------------------------------------------------------------
+# Full CLI path under multi-process dp: main.py must split the GLOBAL
+# config batch_size across ranks and the csv base iterator must shard
+# rows by rank (disjoint strided shards), with no hand-slicing outside
+# the framework.
+# ---------------------------------------------------------------------------
+
+CLI_WORKER = r"""
+import os, sys
+import numpy as np
+sys.path.insert(0, %(repo)r)
+
+from cxxnet_tpu.parallel import force_virtual_cpu
+force_virtual_cpu(2)
+from cxxnet_tpu.parallel import init_distributed
+init_distributed()
+
+import jax
+assert jax.process_count() == 2
+
+from cxxnet_tpu.main import LearnTask
+
+workdir = os.environ["CXXNET_TEST_WORKDIR"]
+rc = LearnTask().run([workdir + "/cli.conf"])
+assert rc == 0, "CLI train failed rc=%%d" %% rc
+print("CLIWORKER%%d OK" %% jax.process_index())
+"""
+
+CLI_CONF = """
+data = train
+iter = csv
+  filename = %s/cli.csv
+  input_shape = 1,1,10
+  label_width = 1
+iter = end
+netconfig = start
+layer[0->1] = fullc:fc1
+  nhidden = 8
+layer[1->2] = relu
+layer[2->3] = fullc:fc2
+  nhidden = 4
+layer[3->3] = softmax
+netconfig = end
+input_shape = 1,1,10
+batch_size = 8
+eta = 0.2
+num_round = 2
+max_round = 2
+metric = error
+model_dir = %s/cli_models
+silent = 1
+"""
+
+
+def test_cli_two_process_training(tmp_path):
+    rng = np.random.RandomState(3)
+    X = rng.rand(32, 10).astype(np.float32)
+    y = (X @ rng.randn(10, 4)).argmax(1)
+    with open(tmp_path / "cli.csv", "w") as f:
+        for i in range(32):
+            f.write(",".join([str(y[i])] + ["%g" % v for v in X[i]])
+                    + "\n")
+    (tmp_path / "cli.conf").write_text(CLI_CONF
+                                       % (tmp_path, tmp_path))
+    script = str(tmp_path / "cli_worker.py")
+    with open(script, "w") as f:
+        f.write(CLI_WORKER % {"repo": REPO})
+
+    port = _free_port()
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "CXXNET_COORDINATOR": "127.0.0.1:%d" % port,
+            "CXXNET_NUM_PROCESSES": "2",
+            "CXXNET_PROCESS_ID": str(r),
+            "CXXNET_TEST_WORKDIR": str(tmp_path),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    try:
+        for r, p in enumerate(procs):
+            out, _ = p.communicate(timeout=300)
+            txt = out.decode(errors="replace")
+            assert p.returncode == 0, "rank %d failed:\n%s" % (r, txt)
+            assert ("CLIWORKER%d OK" % r) in txt, txt
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+
+    # root-only snapshots exist for both rounds
+    assert (tmp_path / "cli_models" / "0001.model.npz").exists()
+    assert (tmp_path / "cli_models" / "0002.model.npz").exists()
+
+
+def test_csv_rank_sharding():
+    """Explicit part_index/num_parts give disjoint strided shards that
+    union to the full row set (single process; no distributed init)."""
+    import tempfile
+    from cxxnet_tpu.io.iter_csv import CSVIterator
+    with tempfile.NamedTemporaryFile("w", suffix=".csv",
+                                     delete=False) as f:
+        for i in range(7):
+            f.write("%d,%d,%d\n" % (i % 3, i, i * 10))
+        path = f.name
+    seen = {}
+    for pi in range(2):
+        it = CSVIterator()
+        it.set_param("filename", path)
+        it.set_param("input_shape", "1,1,2")
+        it.set_param("silent", "1")
+        it.set_param("part_index", str(pi))
+        it.set_param("num_parts", "2")
+        it.init()
+        got = []
+        it.before_first()
+        while it.next():
+            got.append(it.value().index)
+        seen[pi] = set(got)
+    assert seen[0] == {0, 2, 4, 6}
+    assert seen[1] == {1, 3, 5}
+    os.unlink(path)
+
+
+def test_launch_py_two_process(tmp_path):
+    """example/multi-machine/launch.py spawns n CLI workers that join
+    one training job (the ps-lite local-mode launcher equivalent)."""
+    rng = np.random.RandomState(5)
+    X = rng.rand(32, 10).astype(np.float32)
+    y = (X @ rng.randn(10, 4)).argmax(1)
+    with open(tmp_path / "cli.csv", "w") as f:
+        for i in range(32):
+            f.write(",".join([str(y[i])] + ["%g" % v for v in X[i]])
+                    + "\n")
+    (tmp_path / "cli.conf").write_text(CLI_CONF
+                                       % (tmp_path, tmp_path))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("CXXNET_COORDINATOR", None)
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "example", "multi-machine", "launch.py"),
+         "-n", "2", "--devices-per-worker", "1",
+         str(tmp_path / "cli.conf")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=300)
+    txt = out.stdout.decode(errors="replace")
+    assert out.returncode == 0, txt
+    assert (tmp_path / "cli_models" / "0002.model.npz").exists(), txt
+    # rank-prefixed streams from both workers
+    assert "[0]" in txt and "[1]" in txt, txt

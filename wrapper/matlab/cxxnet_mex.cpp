@@ -66,7 +66,7 @@ static std::vector<cxn_real_t> ToRowMajor4(const mxArray *a,
 
 static mxArray *FromRowMajor(const cxn_real_t *p, const cxn_uint shape[4],
                              int ndim) {
-  mwSize dims[4];
+  mwSize dims[4] = {1, 1, 1, 1};
   for (int i = 0; i < ndim; ++i) dims[i] = shape[i];
   mxArray *out = mxCreateNumericArray(ndim, dims, mxSINGLE_CLASS, mxREAL);
   float *dst = reinterpret_cast<float *>(mxGetData(out));

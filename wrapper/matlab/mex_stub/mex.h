@@ -1,0 +1,69 @@
+/*
+ * Minimal mex.h stub for COMPILE-ONLY smoke testing of cxxnet_mex.cpp.
+ *
+ * No Matlab is available in CI, so this header supplies just enough of
+ * the mx/mex API surface (types, class IDs, prototypes) to typecheck
+ * and compile the mex source the way a real
+ * $MATLAB/extern/include/mex.h would. The shim implementations in
+ * mex_stub.cc exist only to satisfy the linker for an object-level
+ * build; nothing here is ever executed. Mirrors the subset the
+ * reference's 440-line mex file relies on
+ * (/root/reference/wrapper/matlab/cxxnet_mex.cpp).
+ */
+#ifndef CXXNET_MEX_STUB_H_
+#define CXXNET_MEX_STUB_H_
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+typedef size_t mwSize;
+typedef ptrdiff_t mwSignedIndex;
+
+typedef enum {
+  mxUNKNOWN_CLASS = 0,
+  mxCELL_CLASS,
+  mxSTRUCT_CLASS,
+  mxLOGICAL_CLASS,
+  mxCHAR_CLASS,
+  mxVOID_CLASS,
+  mxDOUBLE_CLASS,
+  mxSINGLE_CLASS,
+  mxINT8_CLASS,
+  mxUINT8_CLASS,
+  mxINT16_CLASS,
+  mxUINT16_CLASS,
+  mxINT32_CLASS,
+  mxUINT32_CLASS,
+  mxINT64_CLASS,
+  mxUINT64_CLASS
+} mxClassID;
+
+typedef enum { mxREAL = 0, mxCOMPLEX } mxComplexity;
+
+typedef struct mxArray_tag mxArray;
+
+mxArray *mxCreateNumericArray(mwSize ndim, const mwSize *dims,
+                              mxClassID classid, mxComplexity flag);
+mxArray *mxCreateNumericMatrix(mwSize m, mwSize n, mxClassID classid,
+                               mxComplexity flag);
+mxArray *mxCreateDoubleScalar(double value);
+mxArray *mxCreateString(const char *str);
+char *mxArrayToString(const mxArray *a);
+void mxFree(void *ptr);
+void *mxGetData(const mxArray *a);
+double mxGetScalar(const mxArray *a);
+mwSize mxGetNumberOfDimensions(const mxArray *a);
+const mwSize *mxGetDimensions(const mxArray *a);
+bool mxIsSingle(const mxArray *a);
+
+void mexErrMsgTxt(const char *msg);
+
+/* entry point every mex file exports */
+void mexFunction(int nlhs, mxArray *plhs[],
+                 int nrhs, const mxArray *prhs[]);
+
+}  /* extern "C" */
+
+#endif  /* CXXNET_MEX_STUB_H_ */

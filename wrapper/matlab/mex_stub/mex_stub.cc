@@ -1,0 +1,40 @@
+/*
+ * Linker shims for the compile-only mex smoke test (see mex.h here).
+ * Never executed — they exist so cxxnet_mex.cpp can link into a shared
+ * object in CI without Matlab, catching missing-symbol typos as well as
+ * type errors.
+ */
+#include "mex.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+struct mxArray_tag { int unused; };
+
+static mxArray dummy_array;
+
+mxArray *mxCreateNumericArray(mwSize, const mwSize *, mxClassID,
+                              mxComplexity) { return &dummy_array; }
+mxArray *mxCreateNumericMatrix(mwSize, mwSize, mxClassID,
+                               mxComplexity) { return &dummy_array; }
+mxArray *mxCreateDoubleScalar(double) { return &dummy_array; }
+mxArray *mxCreateString(const char *) { return &dummy_array; }
+char *mxArrayToString(const mxArray *) {
+  return static_cast<char *>(std::malloc(1));
+}
+void mxFree(void *ptr) { std::free(ptr); }
+void *mxGetData(const mxArray *) { return nullptr; }
+double mxGetScalar(const mxArray *) { return 0.0; }
+mwSize mxGetNumberOfDimensions(const mxArray *) { return 0; }
+const mwSize *mxGetDimensions(const mxArray *) { return nullptr; }
+bool mxIsSingle(const mxArray *) { return true; }
+
+void mexErrMsgTxt(const char *msg) {
+  std::fprintf(stderr, "mex error: %s\n", msg ? msg : "");
+  std::abort();
+}
+
+}  /* extern "C" */
