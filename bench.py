@@ -33,7 +33,8 @@ MODELS = {
 
 
 def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
-            dtype: str = "bfloat16") -> float:
+            dtype: str = "bfloat16",
+            grad_dtype: str = "float32") -> float:
     import jax
     import cxxnet_tpu.models as zoo
     from cxxnet_tpu.io.data import DataBatch
@@ -46,7 +47,8 @@ def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
     builder = getattr(zoo, model)
     t = NetTrainer(parse_config(builder(nclass=1000, batch_size=batch,
                                         image_size=size))
-                   + [("eval_train", "0"), ("dtype", dtype)])
+                   + [("eval_train", "0"), ("dtype", dtype),
+                      ("grad_dtype", grad_dtype), ("silent", "1")])
     t.init_model()
 
     rng = np.random.RandomState(0)
@@ -86,20 +88,45 @@ def _make_rec(path: str, n: int = 2048, size: int = 256) -> None:
     w.close()
 
 
+def _make_raw_rec(path: str, n: int = 2048, size: int = 256) -> None:
+    """Pack n synthetic RAW uint8 tensors (no jpeg): the decode-free
+    archive for --pipeline-raw."""
+    import os
+    if os.path.exists(path):
+        return
+    from cxxnet_tpu.io.recordio import (RecordIOWriter,
+                                        pack_raw_tensor_record)
+    rng = np.random.RandomState(0)
+    w = RecordIOWriter(path)
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3), np.uint8)
+        w.write_record(pack_raw_tensor_record(i, float(i % 1000), img))
+    w.close()
+
+
 def measure_pipeline(batch: int = 256, rec_path: str = "/tmp/bench.rec",
-                     n_images: int = 2048):
+                     n_images: int = 2048, raw: bool = False):
     """End-to-end throughput: imgrec -> decode pool -> augment (rand
     crop 227 + mirror) -> batch -> threadbuffer prefetch -> device
-    train step. Returns (img/s end-to-end, duty cycle vs pure compute)
-    — the reference's >95% GPU-utilization criterion
-    (doc/debug_perf.md:3-5) measured the TPU way."""
+    train step. Returns (img/s end-to-end, duty cycle vs pure compute,
+    pure img/s, eval img/s) — the reference's >95% GPU-utilization
+    criterion (doc/debug_perf.md:3-5) measured the TPU way.
+
+    raw=True uses pre-packed raw uint8 tensor records (no jpeg in the
+    loop), bounding the NON-decode pipeline overhead on this host —
+    the falsifiable form of the 'decode-bound, not design-bound' claim
+    in doc/perf_profile.md."""
     from cxxnet_tpu.io import create_iterator
     from cxxnet_tpu.io.data import DataBatch
     from cxxnet_tpu.models import alexnet
     from cxxnet_tpu.nnet.trainer import NetTrainer
     from cxxnet_tpu.utils.config import parse_config
 
-    _make_rec(rec_path, n_images)
+    if raw:
+        rec_path = rec_path.replace(".rec", "_raw.rec")
+        _make_raw_rec(rec_path, n_images)
+    else:
+        _make_rec(rec_path, n_images)
     it = create_iterator(
         [("iter", "imgrec"), ("path_imgrec", rec_path),
          ("decode_uint8", "1"), ("rand_crop", "1"), ("rand_mirror", "1"),
@@ -131,12 +158,23 @@ def measure_pipeline(batch: int = 256, rec_path: str = "/tmp/bench.rec",
         nimg += b.batch_size - b.num_batch_padd
     _ = t.last_loss
     dt = time.perf_counter() - start
-    it.close()
     e2e = nimg / dt
+
+    # eval pass through the SAME pipeline (uint8 ship + prefetch H2D;
+    # nnet_impl-inl.hpp:241-276 evaluates through the training input
+    # path)
+    start = time.perf_counter()
+    nimg = 0
+    it.before_first()
+    for b in it:
+        t.predict(b)
+        nimg += b.batch_size - b.num_batch_padd
+    eval_ips = nimg / (time.perf_counter() - start)
+    it.close()
 
     # pure-compute reference on a resident batch (test_skipread mode)
     pure = measure(steps=50, batch=batch)
-    return e2e, min(e2e / pure, 1.0), pure
+    return e2e, min(e2e / pure, 1.0), pure, eval_ips
 
 
 def main():
@@ -144,25 +182,36 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--pipeline", action="store_true",
                     help="end-to-end imgrec pipeline mode")
+    ap.add_argument("--pipeline-raw", action="store_true",
+                    help="pipeline mode over pre-decoded raw-tensor "
+                         "records (no jpeg): bounds non-decode overhead")
     ap.add_argument("--model", choices=sorted(MODELS), default="alexnet")
     ap.add_argument("--steps", type=int, default=None,
                     help="scanned steps (default: 200 alexnet, 50 others)")
     ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--grad-dtype", choices=["float32", "bfloat16"],
+                    default="float32",
+                    help="gradient/cotangent dtype (f32 master weights "
+                         "either way)")
     args = ap.parse_args()
-    if args.pipeline:
-        e2e, duty, pure = measure_pipeline()
+    if args.pipeline or args.pipeline_raw:
+        e2e, duty, pure, eval_ips = measure_pipeline(
+            raw=args.pipeline_raw)
         print(json.dumps({
-            "metric": "end-to-end images/sec (imgrec pipeline)",
+            "metric": "end-to-end images/sec (imgrec pipeline%s)"
+                      % (", raw records" if args.pipeline_raw else ""),
             "value": round(e2e, 1),
             "unit": "images/sec",
             "duty_cycle_vs_pure_compute": round(duty, 3),
             "pure_compute_images_per_sec": round(pure, 1),
+            "eval_images_per_sec": round(eval_ips, 1),
         }))
         return
     model = args.model
     steps = args.steps if args.steps is not None else (
         200 if model == "alexnet" else 50)
-    ips = measure(steps=steps, batch=args.batch, model=model)
+    ips = measure(steps=steps, batch=args.batch, model=model,
+                  grad_dtype=args.grad_dtype)
     # 'AlexNet' spelling keeps the canonical BENCH metric name stable
     # across rounds
     name = "AlexNet" if model == "alexnet" else model

@@ -25,7 +25,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .data import DataInst, IIterator
-from .recordio import RecordIOReader, unpack_image_record
+from .recordio import (RAW_TENSOR_FLAG, RecordIOReader, record_flag,
+                       unpack_image_record, unpack_raw_tensor_record)
 from ..utils.stream import open_stream
 
 
@@ -128,6 +129,12 @@ class ImageRecordIterator(IIterator):
     # -- decode ----------------------------------------------------------
 
     def _decode(self, rec: bytes) -> Optional[DataInst]:
+        if record_flag(rec) == RAW_TENSOR_FLAG:
+            # pre-decoded uint8 tensor record: no jpeg in the loop
+            index, label, data = unpack_raw_tensor_record(rec)
+            if not self.decode_uint8:
+                data = data.astype(np.float32)
+            return self._with_label(index, label, data)
         import cv2
         index, label, payload = unpack_image_record(rec)
         img = cv2.imdecode(np.frombuffer(payload, np.uint8),
@@ -137,11 +144,14 @@ class ImageRecordIterator(IIterator):
         data = img[:, :, ::-1]                        # BGR -> RGB
         if not self.decode_uint8:
             data = data.astype(np.float32)
+        return self._with_label(index, label, data)
+
+    def _with_label(self, index: int, label: float,
+                    data: np.ndarray) -> DataInst:
+        lab = None
         if self._label_map is not None:
             lab = self._label_map.get(index)
-            if lab is None:
-                lab = np.full((self.label_width,), label, np.float32)
-        else:
+        if lab is None:
             lab = np.full((self.label_width,), label, np.float32)
         return DataInst(index=index, data=data, label=lab)
 

@@ -257,3 +257,34 @@ def pack_image_record(index: int, label: float, img_bytes: bytes,
 def unpack_image_record(rec: bytes) -> Tuple[int, float, bytes]:
     flag, label, id0, id1 = _HDR.unpack_from(rec, 0)
     return int(id0), float(label), rec[_HDR.size:]
+
+
+def record_flag(rec: bytes) -> int:
+    return _HDR.unpack_from(rec, 0)[0]
+
+
+# flag value marking a raw uint8 HWC tensor payload (decode-free input
+# records: the pre-decoded path of debug_perf.md's test_io methodology)
+RAW_TENSOR_FLAG = 0x52415754            # 'RAWT'
+
+_RAW_SHAPE = struct.Struct("<HHH")
+
+
+def pack_raw_tensor_record(index: int, label: float,
+                           arr) -> bytes:
+    """Pack a raw uint8 HWC image tensor (no jpeg encode/decode)."""
+    a = np.ascontiguousarray(arr, np.uint8)
+    assert a.ndim == 3, "raw tensor records are HWC uint8"
+    return (_HDR.pack(RAW_TENSOR_FLAG, label, index, 0)
+            + _RAW_SHAPE.pack(*a.shape) + a.tobytes())
+
+
+def unpack_raw_tensor_record(rec: bytes):
+    """-> (index, label, uint8 HWC array); only for RAW_TENSOR_FLAG
+    records."""
+    flag, label, id0, _ = _HDR.unpack_from(rec, 0)
+    assert flag == RAW_TENSOR_FLAG
+    h, w, c = _RAW_SHAPE.unpack_from(rec, _HDR.size)
+    off = _HDR.size + _RAW_SHAPE.size
+    arr = np.frombuffer(rec, np.uint8, h * w * c, off).reshape(h, w, c)
+    return int(id0), float(label), arr

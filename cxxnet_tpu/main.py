@@ -44,6 +44,7 @@ class LearnTask:
         self.print_step = 100
         self.silent = 0
         self.task_eval_train = 1
+        self.test_on_server = 0
         self.name_pred = "pred.txt"
         self.extract_node_name = ""
         self.weight_filename = "weight.txt"
@@ -77,6 +78,8 @@ class LearnTask:
             self.silent = int(val)
         if name in ("eval_train", "train_eval"):
             self.task_eval_train = int(val)
+        if name == "test_on_server":
+            self.test_on_server = int(val)
         if name == "extract_node_name":
             self.extract_node_name = val
             self.task = "extract_feature"
@@ -252,9 +255,15 @@ class LearnTask:
                 line += trainer.evaluate(it, name)
             if self.silent == 0 and is_root():
                 print(line)
+            if self.test_on_server:
+                # per-round weight consistency audit (the reference's
+                # test_on_server CheckWeight_, async_updater-inl.hpp:
+                # 149-154): every device replica must hold identical
+                # weights
+                trainer.check_weight_consistency()
             if self.save_period and (r + 1) % self.save_period == 0 \
                     and is_root():
-                os.makedirs(self.model_dir, exist_ok=True)
+                # open_stream creates local dirs; remote URIs need none
                 trainer.save_model(self._model_path(r + 1))
         if self.silent == 0 and is_root():
             print("updating end, %ld sec in all"

@@ -75,10 +75,14 @@ class NetTrainer:
         self.silent = 0
         self.model_parallel_min = 0      # 0 = no model-parallel sharding
         self.shard_optimizer = 0         # ZeRO-1 (update_on_server analogue)
+        self.grad_dtype = "float32"      # bfloat16: bf16 cotangents +
+        #                                  bf16 grad all-reduce, f32
+        #                                  master weights in the updater
         self.sample_counter = 0          # within accumulation window
         self.update_counter = 0          # applied updates (schedule epoch)
         self.round = 0
         self._initialized = False
+        self._warned_scan_schedule = False
 
     # -- config ----------------------------------------------------------
 
@@ -100,6 +104,11 @@ class NetTrainer:
                 self.silent = int(val)
             if name == "model_parallel_min":
                 self.model_parallel_min = int(val)
+            if name == "grad_dtype":
+                if val not in ("float32", "bfloat16"):
+                    raise ValueError(
+                        "grad_dtype must be float32 or bfloat16")
+                self.grad_dtype = val
             if name in ("shard_optimizer", "update_on_server"):
                 # update_on_server=1 meant "optimizer state lives off the
                 # workers" (nnet_ps_server.cpp); here it means "optimizer
@@ -240,6 +249,31 @@ class NetTrainer:
                     new_o[lk][tag] = s2
             return new_p, new_o
 
+        grad_bf16 = self.grad_dtype == "bfloat16"
+        if grad_bf16 and not any(
+                k == "dtype" and v == "bfloat16" for k, v in self.cfg):
+            raise ValueError(
+                "grad_dtype=bfloat16 requires dtype=bfloat16 (layers "
+                "must consume the bf16 weight shadow)")
+
+        def _grad_cast(params):
+            """bf16 shadow of the f32 master weights to differentiate
+            against: cotangents then flow (and all-reduce across the
+            'data' axis) in bf16 — half the gradient HBM/ICI bytes —
+            while apply_updates reads the f32 masters (SURVEY §7 step 8
+            mixed precision)."""
+            if not grad_bf16:
+                return params
+            return jax.tree_util.tree_map(
+                lambda w: w.astype(jnp.bfloat16)
+                if w.dtype == jnp.float32 else w, params)
+
+        def _grad_f32(grads):
+            if not grad_bf16:
+                return grads
+            return jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
+
         def train_step(params, opt_state, net_state, grad_acc,
                        data, labels, mask, extra, hyper_arr, step,
                        base_key, do_update):
@@ -249,14 +283,15 @@ class NetTrainer:
             rng = jax.random.fold_in(base_key, step)
             (loss, (new_state, preds)), grads = jax.value_and_grad(
                 net.loss_fn, has_aux=True)(
-                    params, net_state, data, labels, mask, extra=extra,
-                    rng=rng, collect_nodes=metric_nodes)
+                    _grad_cast(params), net_state, data, labels, mask,
+                    extra=extra, rng=rng, collect_nodes=metric_nodes)
             preds = [p.astype(jnp.float32) for p in preds]
             if update_period == 1:
                 params, opt_state = apply_updates(
-                    params, opt_state, grads, hyper_arr)
+                    params, opt_state, _grad_f32(grads), hyper_arr)
                 return params, opt_state, new_state, grad_acc, loss, preds
-            grad_acc = _tree_add(grad_acc, grads)
+            # accumulate in f32 regardless of gradient dtype
+            grad_acc = _tree_add(grad_acc, _grad_f32(grads))
 
             def do_apply(args):
                 p, o, acc = args
@@ -451,8 +486,19 @@ class NetTrainer:
     def run_steps(self, batch: DataBatch, n_steps: int) -> None:
         """Run n_steps full update steps on one resident batch in a
         single dispatch (steady-state throughput measurement — the
-        test_skipread mode, iter_batch_proc-inl.hpp:21)."""
+        test_skipread mode, iter_batch_proc-inl.hpp:21).
+
+        LR/momentum are evaluated ONCE for the window: a non-constant
+        schedule does not advance inside the scan, so for real training
+        across schedule boundaries use ``update()`` per step."""
         assert self._initialized and self.update_period == 1
+        if self.silent == 0 and not self._warned_scan_schedule and any(
+                u.param.lr_schedule != 0
+                for tags in self.updaters.values()
+                for u in tags.values()):
+            self._warned_scan_schedule = True
+            print("run_steps: non-constant lr schedule is frozen for "
+                  "the %d-step scan window" % n_steps)
         data, labels, mask, extra = self._device_batch(batch)
         out = self._multi_step(self.params, self.opt_state,
                                self.net_state, data, labels, mask,
@@ -518,6 +564,50 @@ class NetTrainer:
                                  nodes_wanted=(ni,))
         nvalid = self._local_batch_size(batch) - batch.num_batch_padd
         return self._local_rows(val, flatten=False)[:nvalid]
+
+    def check_weight_consistency(self, atol: float = 0.0) -> None:
+        """Assert every device replica holds identical weights — the
+        ``test_on_server=1`` audit (reference CheckWeight_,
+        async_updater-inl.hpp:149-154). With SPMD + pinned replicated
+        out-shardings this should hold bitwise; a mismatch means a
+        sharding or donation bug. Partially-sharded weights (e.g.
+        model-axis fullc) are compared within each replica group;
+        identical NaNs count as equal (a numerical blow-up is not a
+        replication bug). Under multi-process dp, fully-replicated
+        weights are also cross-checked between ranks."""
+        from collections import defaultdict
+
+        def _differs(a, b):
+            return not np.allclose(a, b, rtol=0.0, atol=atol,
+                                   equal_nan=True)
+
+        for lk, pt in self.params.items():
+            for tag, w in pt.items():
+                if not isinstance(w, jax.Array):
+                    continue
+                groups = defaultdict(list)
+                for s in w.addressable_shards:
+                    groups[s.index].append(s)
+                for shards in groups.values():
+                    ref = np.asarray(shards[0].data)
+                    for s in shards[1:]:
+                        if _differs(ref, np.asarray(s.data)):
+                            raise AssertionError(
+                                "weight %s:%s diverged between device "
+                                "replicas %s and %s"
+                                % (lk, tag, shards[0].device, s.device))
+                if jax.process_count() > 1 and len(groups) == 1:
+                    # fully replicated: audit across ranks too
+                    from jax.experimental import multihost_utils
+                    ref = np.asarray(w.addressable_shards[0].data)
+                    allv = np.asarray(
+                        multihost_utils.process_allgather(ref))
+                    for r in range(allv.shape[0]):
+                        if _differs(ref, allv[r]):
+                            raise AssertionError(
+                                "weight %s:%s diverged between process "
+                                "ranks (rank %d vs %d)"
+                                % (lk, tag, jax.process_index(), r))
 
     # -- weights ---------------------------------------------------------
 

@@ -77,6 +77,19 @@ def profile(model: str = "inception_bn", batch: int = 0,
     t.run_steps(b, steps)        # compile + warm
     _ = t.last_loss
 
+    # XLA's own FLOP count for the scanned program -> honest MFU
+    flops_per_step = None
+    try:
+        data, labels, mask, extra = t._device_batch(b)
+        ca = t._multi_step.lower(
+            t.params, t.opt_state, t.net_state, data, labels, mask,
+            extra, t._hyper(), t._step_scalar(), t._base_key,
+            n_steps=steps).compile().cost_analysis()
+        if ca and "flops" in ca:
+            flops_per_step = float(ca["flops"]) / steps
+    except Exception as e:
+        print("cost_analysis unavailable: %s" % e)
+
     t0 = time.perf_counter()
     t.run_steps(b, steps)
     _ = t.last_loss
@@ -125,6 +138,11 @@ def profile(model: str = "inception_bn", batch: int = 0,
     print("== %s  batch %d  (%d-step scan) ==" % (model, batch, steps))
     print("wall: %.2f ms/step  -> %.0f img/s" % (wall_ms,
                                                  batch / wall_ms * 1e3))
+    if flops_per_step:
+        tf = flops_per_step / (wall_ms / 1e3) / 1e12
+        print("XLA cost_analysis flops/step: %.1f G -> %.1f TFLOP/s "
+              "(CAUTION: undercounts fused convs on the TPU backend; "
+              "use analytic FLOPs for MFU)" % (flops_per_step / 1e9, tf))
     print("device busy (sum sync-op self-times): %.2f ms/step"
           % (busy / steps))
     print("async (overlapped DMA) in-flight total: %.2f ms/step"

@@ -66,3 +66,17 @@ def test_mnist_conv_accuracy(tmp_path, monkeypatch, capsys):
     # reference convnet target: ~99% (error < 0.01)
     assert best < 0.01, "conv val error %.4f (want < 0.01); curve=%s" \
         % (best, errs)
+
+
+def test_mnist_conv_accuracy_bf16_grads(tmp_path, monkeypatch, capsys):
+    """Convergence gate for the mixed-precision path: bf16 compute AND
+    bf16 gradients (f32 master weights) must still hit the reference
+    convnet target (~99%, example/MNIST/README.md:208)."""
+    _prepare(tmp_path)
+    errs = _run_conf(tmp_path, monkeypatch, capsys, "MNIST_CONV.conf",
+                     ["num_round=12", "dtype=bfloat16",
+                      "grad_dtype=bfloat16"])
+    best = min(errs)
+    assert best < 0.01, \
+        "bf16-grad conv val error %.4f (want < 0.01); curve=%s" \
+        % (best, errs)

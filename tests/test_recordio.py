@@ -186,3 +186,46 @@ def test_imgrec_distributed_parts(tmp_path):
         while it.next():
             seen.append(it.value().index)
     assert sorted(seen) == list(range(20))
+
+
+def test_raw_tensor_records(tmp_path):
+    """Decode-free raw uint8 tensor records round-trip through the
+    imgrec iterator (the --pipeline-raw input path)."""
+    import numpy as np
+    from cxxnet_tpu.io.recordio import (RecordIOWriter,
+                                        pack_raw_tensor_record,
+                                        unpack_raw_tensor_record)
+    from cxxnet_tpu.io.iter_imgrec import ImageRecordIterator
+
+    rng = np.random.RandomState(0)
+    imgs = [rng.randint(0, 255, (8, 6, 3), np.uint8) for _ in range(5)]
+    p = str(tmp_path / "raw.rec")
+    w = RecordIOWriter(p, force_python=True)
+    for i, img in enumerate(imgs):
+        w.write_record(pack_raw_tensor_record(i, float(i % 2), img))
+    w.close()
+
+    # direct unpack
+    from cxxnet_tpu.io.recordio import RecordIOReader
+    r = RecordIOReader(p, force_python=True)
+    idx, lab, arr = unpack_raw_tensor_record(r.next_record())
+    assert idx == 0 and lab == 0.0
+    np.testing.assert_array_equal(arr, imgs[0])
+    r.close()
+
+    # through the iterator: float32 path and uint8 path
+    for u8 in (0, 1):
+        it = ImageRecordIterator()
+        it.set_param("path_imgrec", p)
+        it.set_param("silent", "1")
+        it.set_param("decode_uint8", str(u8))
+        it.init()
+        got = []
+        while it.next():
+            got.append(it.value())
+        assert len(got) == 5
+        want_dtype = np.uint8 if u8 else np.float32
+        assert got[0].data.dtype == want_dtype
+        np.testing.assert_array_equal(
+            np.asarray(got[2].data, np.uint8), imgs[2])
+        it.close()

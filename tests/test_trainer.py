@@ -393,3 +393,51 @@ def test_batchnorm_ignores_padded_rows():
                                np.asarray(tb.params["cv1"]["wmat"]),
                                rtol=1e-5, atol=1e-7)
     assert np.isfinite(ta.last_loss) and np.isfinite(tb.last_loss)
+
+
+def test_check_weight_consistency():
+    """test_on_server analogue: replicated weights identical across
+    devices after training steps (CheckWeight_, async_updater-inl.hpp:
+    149-154); a corrupted replica is detected."""
+    import jax
+    import numpy as np
+    from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config
+
+    conf = """
+netconfig = start
+layer[0->1] = fullc:fc1
+  nhidden = 8
+layer[1->2] = relu
+layer[2->3] = fullc:fc2
+  nhidden = 3
+layer[3->3] = softmax
+netconfig = end
+input_shape = 1,1,6
+batch_size = 8
+eta = 0.1
+eval_train = 0
+"""
+    t = NetTrainer(parse_config(conf))
+    t.init_model()
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        t.update(DataBatch(
+            data=rng.rand(8, 6).astype(np.float32),
+            label=rng.randint(0, 3, (8, 1)).astype(np.float32)))
+    t.check_weight_consistency()          # passes after real updates
+
+    # corrupt one replica -> detected
+    w = t.params["fc1"]["wmat"]
+    if len(w.addressable_shards) >= 2:
+        vals = [np.asarray(s.data) for s in w.addressable_shards]
+        vals[1] = vals[1] + 1.0
+        bufs = [jax.device_put(v, s.device)
+                for v, s in zip(vals, w.addressable_shards)]
+        bad = jax.make_array_from_single_device_arrays(
+            w.shape, w.sharding, bufs)
+        t.params["fc1"] = dict(t.params["fc1"], wmat=bad)
+        import pytest
+        with pytest.raises(AssertionError, match="diverged"):
+            t.check_weight_consistency()
