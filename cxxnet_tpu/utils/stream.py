@@ -105,7 +105,8 @@ def list_stream_dir(uri: str):
     try:
         import fsspec
         fs, root = fsspec.core.url_to_fs(uri)
-        return [p.rstrip("/").rsplit("/", 1)[-1] for p in fs.ls(root)]
+        return [p.rstrip("/").rsplit("/", 1)[-1]
+                for p in fs.ls(root, detail=False)]
     except FileNotFoundError:
         return []
     except (ImportError, ValueError):

@@ -189,3 +189,62 @@ def test_meanimg_cache_remote():
     it2 = create_iterator(base_cfg, [("batch_size", "2"),
                                      ("input_shape", "1,1,4")])
     it2.init()
+
+
+def test_continue_resume_remote_model_dir(tmp_path, capsys):
+    """continue=1 with a REMOTE model_dir (fsspec memory://): snapshots
+    save remotely, and a restarted run finds the newest one via
+    list_stream_dir instead of silently restarting from round 0
+    (reference cxxnet_main.cpp:180-202 through dmlc Stream)."""
+    pytest.importorskip("fsspec")
+    from fsspec.implementations.memory import MemoryFileSystem
+    MemoryFileSystem.store.clear()
+
+    rows = np.hstack([np.arange(20).reshape(20, 1) % 4,
+                      np.random.RandomState(0).rand(20, 6)])
+    csv = tmp_path / "t.csv"
+    with open(csv, "w") as f:
+        for r in rows:
+            f.write(",".join("%g" % x for x in r) + "\n")
+    conf = tmp_path / "t.conf"
+    conf.write_text("""
+data = train
+iter = csv
+  filename = %s
+  input_shape = 1,1,6
+iter = end
+netconfig = start
+layer[0->1] = fullc:fc1
+  nhidden = 8
+layer[1->2] = relu
+layer[2->3] = fullc:fc2
+  nhidden = 4
+layer[3->3] = softmax
+netconfig = end
+input_shape = 1,1,6
+batch_size = 10
+eta = 0.1
+num_round = 2
+max_round = 2
+metric = error
+model_dir = memory://ckpt
+""" % csv)
+
+    from cxxnet_tpu.main import LearnTask
+    rc = LearnTask().run([str(conf)])
+    assert rc == 0
+    capsys.readouterr()
+    assert any(k.endswith("0002.model.npz")
+               for k in MemoryFileSystem.store)
+
+    # restart with continue=1 and more rounds: must resume at round 3,
+    # not retrain 1-2
+    rc = LearnTask().run([str(conf), "continue=1", "num_round=3",
+                          "max_round=3"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert any(k.endswith("0003.model.npz")
+               for k in MemoryFileSystem.store)
+    # rounds 1-2 NOT retrained (resume skipped straight to round 3)
+    assert "[3]" in out, out
+    assert "[1]" not in out and "[2]" not in out, out
