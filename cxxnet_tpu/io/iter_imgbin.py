@@ -44,6 +44,9 @@ class ImageBinIterator(IIterator):
     def __init__(self):
         self.image_list: List[str] = []
         self.image_bin: List[str] = []
+        self.image_conf_prefix = ""
+        self.image_conf_ids = ""
+        self._conf_expanded = False
         self.label_width = 1
         self.silent = 0
         self.part_index = 0
@@ -63,6 +66,10 @@ class ImageBinIterator(IIterator):
             self.image_list = val.split()
         if name == "image_bin":
             self.image_bin = val.split()
+        if name == "image_conf_prefix":
+            self.image_conf_prefix = val
+        if name == "image_conf_ids":
+            self.image_conf_ids = val
         if name == "label_width":
             self.label_width = int(val)
         if name == "silent":
@@ -78,8 +85,8 @@ class ImageBinIterator(IIterator):
         assert len(self.image_list) == len(self.image_bin), \
             "imgbin: need one image_list per image_bin shard"
         pairs = list(zip(self.image_list, self.image_bin))
-        if self.num_parts <= 1:
-            return pairs
+        if self._conf_sharded or self.num_parts <= 1:
+            return pairs                 # already rank-specific
         assert 0 <= self.part_index < self.num_parts, \
             "imgbin: part_index %d out of range for num_parts %d " \
             "(ranks are 0-based)" % (self.part_index, self.num_parts)
@@ -87,12 +94,48 @@ class ImageBinIterator(IIterator):
             "imgbin: fewer shard files than workers"
         return pairs[self.part_index::self.num_parts]
 
+    def _expand_image_conf(self) -> None:
+        """Expand image_conf_prefix (a %d pattern) + image_conf_ids
+        ("lb-ub") into per-id .lst/.bin shard pairs, with the
+        reference's CONTIGUOUS id-chunk per distributed worker
+        (iter_thread_imbin_x-inl.hpp:113-148)."""
+        if not self.image_conf_prefix:
+            return
+        if self._conf_expanded:          # re-init: rebuild from scratch
+            self.image_list, self.image_bin = [], []
+        assert not self.image_list and not self.image_bin, \
+            "set either image_conf_prefix or image_bin/image_list"
+        self._conf_expanded = True
+        import re
+        m = re.match(r"^(\d+)-(\d+)$", self.image_conf_ids)
+        assert m, "image_conf_ids only support range, like 1-100"
+        lb, ub = int(m.group(1)), int(m.group(2))
+        from .data import resolve_data_shard
+        pi, nparts = resolve_data_shard(self.part_index, self.num_parts)
+        if nparts > 1:
+            # balanced contiguous chunks (the reference's ceil-step
+            # split starves trailing workers, e.g. 4 ids / 3 workers)
+            n = ub + 1 - lb
+            begin = lb + n * pi // nparts
+            end = lb + n * (pi + 1) // nparts
+            assert begin < end, \
+                "imgbin: too many workers to divide image_conf_ids"
+            lb, ub = begin, end - 1
+            self._conf_sharded = True    # id-range split consumed it
+        for i in range(lb, ub + 1):
+            base = self.image_conf_prefix % i
+            self.image_list.append(base + ".lst")
+            self.image_bin.append(base + ".bin")
+
     def init(self) -> None:
+        self._conf_sharded = False
+        self._expand_image_conf()
         assert self.image_bin, "imgbin: image_bin must be set"
         if self._pool is not None:
             self._pool.shutdown(wait=False)
         self._pool = ThreadPoolExecutor(max_workers=self.nthread)
-        if self.num_parts == 1 and len(self.image_bin) > 1:
+        if not self._conf_sharded and self.num_parts == 1 \
+                and len(self.image_bin) > 1:
             # process-rank autodetect, the PS_RANK sniffing of the
             # reference (iter_thread_imbin_x-inl.hpp:116-118). Only for
             # multi-shard configs: a single explicit bin file is read

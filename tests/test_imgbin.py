@@ -137,3 +137,64 @@ def test_imgbin_sharded_parts(tmp_path):
         assert len(part_ids) == 6            # 2 shards x 3 images
         seen.extend(part_ids)
     assert sorted(seen) == list(range(12))   # disjoint + complete
+
+
+def test_image_conf_prefix_expansion(tmp_path):
+    """image_conf_prefix/%d + image_conf_ids range expand into .lst/.bin
+    shard pairs, with contiguous id-chunk partitioning per worker
+    (iter_thread_imbin_x-inl.hpp:113-148)."""
+    import cv2
+    from cxxnet_tpu.io.iter_imgbin import ImageBinIterator
+
+    rng = np.random.RandomState(0)
+    # three shard pairs tr_1 / tr_2 / tr_3, 2 images each
+    for sid in range(1, 4):
+        rows = []
+        w = PageWriter(str(tmp_path / ("tr_%d.bin" % sid)))
+        for j in range(2):
+            img = rng.randint(0, 255, (8, 8, 3), np.uint8)
+            ok, buf = cv2.imencode(".png", img)
+            assert ok
+            w.write(bytes(buf.tobytes()))
+            idx = sid * 10 + j
+            rows.append("%d\t%d\tx.png" % (idx, idx % 3))
+        w.close()
+        (tmp_path / ("tr_%d.lst" % sid)).write_text(
+            "\n".join(rows) + "\n")
+
+    prefix = str(tmp_path / "tr_%d")
+
+    def collect(part=None):
+        it = ImageBinIterator()
+        it.set_param("image_conf_prefix", prefix)
+        it.set_param("image_conf_ids", "1-3")
+        it.set_param("silent", "1")
+        if part is not None:
+            it.set_param("part_index", str(part))
+            it.set_param("num_parts", "2")
+        it.init()
+        got = []
+        while it.next():
+            got.append(it.value().index)
+        it.close()
+        return got
+
+    assert sorted(collect()) == [10, 11, 20, 21, 30, 31]
+    # 2 workers: contiguous chunks (ids 1 | ids 2-3)
+    assert sorted(collect(0)) == [10, 11]
+    assert sorted(collect(1)) == [20, 21, 30, 31]
+
+    # re-init keeps the SAME worker shard (no state consumed)
+    it = ImageBinIterator()
+    it.set_param("image_conf_prefix", prefix)
+    it.set_param("image_conf_ids", "1-3")
+    it.set_param("silent", "1")
+    it.set_param("part_index", "1")
+    it.set_param("num_parts", "2")
+    it.init()
+    it.init()
+    got = []
+    while it.next():
+        got.append(it.value().index)
+    it.close()
+    assert sorted(got) == [20, 21, 30, 31]

@@ -441,3 +441,36 @@ eval_train = 0
         import pytest
         with pytest.raises(AssertionError, match="diverged"):
             t.check_weight_consistency()
+
+
+def test_update_period_with_bf16_grads(tmp_path):
+    """Gradient accumulation stays f32 under grad_dtype=bfloat16: the
+    update_period=2 == big-batch equality must survive bf16 cotangents
+    (within bf16 rounding of the per-microbatch grads)."""
+    ptri, ptrl = synth_idx(str(tmp_path), n=200, name="upbf")
+    common = [("path_img", ptri), ("path_label", ptrl), ("silent", "1")]
+    bf16 = [("dtype", "bfloat16"), ("grad_dtype", "bfloat16")]
+
+    it50 = create_iterator([("iter", "mnist")] + common,
+                           [("batch_size", "50")])
+    it100 = create_iterator([("iter", "mnist")] + common,
+                            [("batch_size", "100")])
+    it50.init()
+    it100.init()
+
+    ta = make_trainer(MLP_CONF, extra=bf16 + [("update_period", "2"),
+                                              ("batch_size", "50")])
+    tb = make_trainer(MLP_CONF.replace("batch_size = 50",
+                                       "batch_size = 100"), extra=bf16)
+    for batch in it50:
+        ta.update(batch)
+    for batch in it100:
+        tb.update(batch)
+    assert ta.update_counter == tb.update_counter == 2
+    # master weights stay f32 and track the big-batch run within bf16
+    # rounding noise of the gradients
+    wa = np.asarray(ta.params["fc1"]["wmat"])
+    wb = np.asarray(tb.params["fc1"]["wmat"])
+    assert wa.dtype == np.float32
+    np.testing.assert_allclose(wa, wb, rtol=0.0, atol=5e-4)
+    assert np.isfinite(ta.last_loss) and np.isfinite(tb.last_loss)
