@@ -142,7 +142,7 @@ class PrefetchIterator(IIterator):
 
     def set_param(self, name: str, val: str) -> None:
         self.base.set_param(name, val)
-        if name == "prefetch_capacity":
+        if name in ("prefetch_capacity", "buffer_size"):
             self.capacity = int(val)
 
     def set_transform(self, fn) -> None:

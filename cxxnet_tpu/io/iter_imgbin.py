@@ -74,9 +74,9 @@ class ImageBinIterator(IIterator):
             self.label_width = int(val)
         if name == "silent":
             self.silent = int(val)
-        if name == "part_index":
+        if name in ("part_index", "dist_worker_rank"):
             self.part_index = int(val)
-        if name == "num_parts":
+        if name in ("num_parts", "dist_num_worker"):
             self.num_parts = int(val)
         if name == "nthread":
             self.nthread = int(val)
@@ -113,6 +113,9 @@ class ImageBinIterator(IIterator):
         from .data import resolve_data_shard
         pi, nparts = resolve_data_shard(self.part_index, self.num_parts)
         if nparts > 1:
+            assert 0 <= pi < nparts, \
+                "imgbin: part_index %d out of range for num_parts %d " \
+                "(ranks are 0-based)" % (pi, nparts)
             # balanced contiguous chunks (the reference's ceil-step
             # split starves trailing workers, e.g. 4 ids / 3 workers)
             n = ub + 1 - lb
@@ -134,16 +137,14 @@ class ImageBinIterator(IIterator):
         if self._pool is not None:
             self._pool.shutdown(wait=False)
         self._pool = ThreadPoolExecutor(max_workers=self.nthread)
-        if not self._conf_sharded and self.num_parts == 1 \
-                and len(self.image_bin) > 1:
+        if not self._conf_sharded and len(self.image_bin) > 1:
             # process-rank autodetect, the PS_RANK sniffing of the
             # reference (iter_thread_imbin_x-inl.hpp:116-118). Only for
             # multi-shard configs: a single explicit bin file is read
             # whole by every worker, as in the reference.
-            import jax
-            if jax.process_count() > 1:
-                self.num_parts = jax.process_count()
-                self.part_index = jax.process_index()
+            from .data import resolve_data_shard
+            self.part_index, self.num_parts = resolve_data_shard(
+                self.part_index, self.num_parts)
         self._shards = self._my_shards()
         # parse the (possibly huge) list files once, not per epoch
         self._shard_rows = [self._read_list(lst)

@@ -50,7 +50,7 @@ class ImageRecordIterator(IIterator):
         self._chunk = 256
 
     def set_param(self, name: str, val: str) -> None:
-        if name == "path_imgrec":
+        if name in ("path_imgrec", "image_rec"):   # reference alias
             self.path_imgrec = val
         if name == "path_imglist":
             self.path_imglist = val

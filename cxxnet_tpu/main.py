@@ -46,6 +46,7 @@ class LearnTask:
         self.task_eval_train = 1
         self.test_on_server = 0
         self.name_pred = "pred.txt"
+        self.output_format = "txt"
         self.extract_node_name = ""
         self.weight_filename = "weight.txt"
         self.weight_layer = ""
@@ -82,7 +83,12 @@ class LearnTask:
             self.test_on_server = int(val)
         if name == "extract_node_name":
             self.extract_node_name = val
-            self.task = "extract_feature"
+        if name == "extract_layer_name":
+            # reference semantics: the get_weight layer selector
+            # (cxxnet_main.cpp:339), NOT an extract_feature trigger
+            self.weight_layer = val
+        if name == "output_format":
+            self.output_format = "txt" if val == "txt" else "bin"
         if name == "weight_filename":
             self.weight_filename = val
         if name == "weight_layer":
@@ -290,12 +296,29 @@ class LearnTask:
         assert world_size() == 1, \
             "task=extract_feature must run single-process"
         node = self.extract_node_name
-        with open_stream(self.name_pred, "w") as f:
+        txt = self.output_format == "txt"
+        nrow, shape3 = 0, (0, 0, 0)
+        mode = "w" if txt else "wb"
+        with open_stream(self.name_pred, mode) as f:
             for batch in itr:
                 feats = trainer.extract_feature(batch, node)
-                feats = feats.reshape(feats.shape[0], -1)
-                for row in feats:
-                    f.write(" ".join("%g" % x for x in row) + "\n")
+                if feats.ndim == 4:      # NHWC -> reference (ch, y, x)
+                    feats = feats.transpose(0, 3, 1, 2)
+                    shape3 = feats.shape[1:]
+                else:
+                    feats = feats.reshape(feats.shape[0], -1)
+                    shape3 = (1, 1, feats.shape[1])
+                nrow += feats.shape[0]
+                if txt:
+                    flat = feats.reshape(feats.shape[0], -1)
+                    for row in flat:
+                        f.write(" ".join("%g" % x for x in row) + "\n")
+                else:
+                    f.write(np.ascontiguousarray(
+                        feats, dtype="<f4").tobytes())
+        # shape sidecar: "nrow,ch,y,x" (cxxnet_main.cpp:418)
+        with open_stream(self.name_pred + ".meta", "w") as fm:
+            fm.write("%d,%d,%d,%d\n" % ((nrow,) + tuple(shape3)))
         print("finished feature extraction, write into %s"
               % self.name_pred)
         return 0

@@ -117,3 +117,40 @@ def test_test_io_mode(setup, capsys):
     tmp_path, conf = setup
     assert main([conf, "test_io=1", "num_round=2"]) == 0
     assert "test_io:" in capsys.readouterr().out
+
+
+def test_extract_output_format_and_meta(setup, capsys):
+    """output_format=bin writes raw float32 rows; both formats write
+    the "nrow,ch,y,x" shape sidecar (cxxnet_main.cpp:368-419)."""
+    tmp_path, conf = setup
+    assert main([conf, "num_round=1"]) == 0
+    model = str(tmp_path / "models" / "0001.model.npz")
+
+    txt_file = str(tmp_path / "feat_t.txt")
+    assert main([conf, "task=extract_feature", "extract_node_name=h",
+                 "model_in=" + model, "pred=" + txt_file]) == 0
+    with open(txt_file + ".meta") as f:
+        meta = f.read().strip()
+    assert meta == "300,1,1,32", meta
+    txt_feats = np.loadtxt(txt_file)
+
+    bin_file = str(tmp_path / "feat_b.bin")
+    assert main([conf, "task=extract_feature", "extract_node_name=h",
+                 "model_in=" + model, "pred=" + bin_file,
+                 "output_format=bin"]) == 0
+    raw = np.fromfile(bin_file, "<f4").reshape(300, 32)
+    np.testing.assert_allclose(raw, txt_feats, rtol=1e-5, atol=1e-5)
+    with open(bin_file + ".meta") as f:
+        assert f.read().strip() == "300,1,1,32"
+
+
+def test_extract_layer_name_is_get_weight_alias(setup, capsys):
+    """extract_layer_name selects the get_weight layer (reference
+    cxxnet_main.cpp:339) and does NOT flip the task."""
+    tmp_path, conf = setup
+    assert main([conf, "num_round=1"]) == 0
+    model = str(tmp_path / "models" / "0001.model.npz")
+    wfile = str(tmp_path / "w2.txt")
+    assert main([conf, "task=get_weight", "extract_layer_name=fc1",
+                 "model_in=" + model, "weight_filename=" + wfile]) == 0
+    assert np.loadtxt(wfile).shape == (32, 256)
