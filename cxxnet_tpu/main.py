@@ -88,7 +88,10 @@ class LearnTask:
             # (cxxnet_main.cpp:339), NOT an extract_feature trigger
             self.weight_layer = val
         if name == "output_format":
-            self.output_format = "txt" if val == "txt" else "bin"
+            if val not in ("txt", "bin"):
+                raise ValueError(
+                    "output_format must be 'txt' or 'bin', got %r" % val)
+            self.output_format = val
         if name == "weight_filename":
             self.weight_filename = val
         if name == "weight_layer":
@@ -326,8 +329,13 @@ class LearnTask:
     def _task_get_weight(self, trainer) -> int:
         assert self.weight_layer, "get_weight requires weight_layer"
         w = trainer.get_weight(self.weight_layer, self.weight_tag)
-        np.savetxt(self.weight_filename, w.reshape(w.shape[0], -1)
-                   if w.ndim > 1 else w[None, :], fmt="%g")
+        rows = w.reshape(w.shape[0], -1) if w.ndim > 1 else w[None, :]
+        if self.output_format == "txt":
+            with open_stream(self.weight_filename, "w") as f:
+                np.savetxt(f, rows, fmt="%g")
+        else:                            # raw float32 (cxxnet_main:350)
+            with open_stream(self.weight_filename, "wb") as f:
+                f.write(np.ascontiguousarray(rows, "<f4").tobytes())
         print("weight %s:%s %s written to %s"
               % (self.weight_layer, self.weight_tag, w.shape,
                  self.weight_filename))

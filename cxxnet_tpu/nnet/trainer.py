@@ -78,6 +78,8 @@ class NetTrainer:
         self.grad_dtype = "float32"      # bfloat16: bf16 cotangents +
         #                                  bf16 grad all-reduce, f32
         #                                  master weights in the updater
+        self.save_optimizer = 0          # 1: checkpoint momentum/adam
+        #                                  state for seamless resume
         self.sample_counter = 0          # within accumulation window
         self.update_counter = 0          # applied updates (schedule epoch)
         self.round = 0
@@ -109,6 +111,8 @@ class NetTrainer:
                     raise ValueError(
                         "grad_dtype must be float32 or bfloat16")
                 self.grad_dtype = val
+            if name == "save_optimizer":
+                self.save_optimizer = int(val)
             if name in ("shard_optimizer", "update_on_server"):
                 # update_on_server=1 meant "optimizer state lives off the
                 # workers" (nnet_ps_server.cpp); here it means "optimizer
@@ -660,6 +664,23 @@ class NetTrainer:
         for lk, st in self.net_state.items():
             for k, v in st.items():
                 arrays["state/%s/%s" % (lk, k)] = np.asarray(v)
+        if self.save_optimizer:
+            # seamless-resume extension (the reference never checkpoints
+            # momentum, nnet_impl-inl.hpp:98-116; off by default for
+            # snapshot-format parity)
+            def fetch(v):
+                # ZeRO-1 leaves span processes under multi-host dp;
+                # gather the global value before saving
+                if isinstance(v, jax.Array) and \
+                        not v.is_fully_addressable:
+                    from jax.experimental import multihost_utils
+                    return np.asarray(multihost_utils.process_allgather(
+                        v, tiled=True))
+                return np.asarray(v)
+            for lk, tags in self.opt_state.items():
+                for tag, st in tags.items():
+                    for k, v in st.items():
+                        arrays["opt/%s/%s/%s" % (lk, tag, k)] = fetch(v)
         meta = {
             "format_version": 1,
             "update_counter": self.update_counter,
@@ -700,6 +721,18 @@ class NetTrainer:
         self.params, self.net_state = params, net_state
         self.update_counter = int(meta.get("update_counter", 0))
         self._post_init()
+        # restore optimizer state when the snapshot carries it
+        if any(k.startswith("opt/") for k in blob):
+            for lk, tags in self.opt_state.items():
+                for tag, st in tags.items():
+                    new = dict(st)
+                    for k in st:
+                        key = "opt/%s/%s/%s" % (lk, tag, k)
+                        if key in blob:
+                            new[k] = jnp.asarray(blob[key])
+                    self.opt_state[lk][tag] = new
+            self.opt_state = jax.device_put(self.opt_state,
+                                            self._o_shard)
 
     def copy_model_from(self, path: str) -> None:
         """Finetune: copy weights for layers whose *names* match

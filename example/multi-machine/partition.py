@@ -29,10 +29,17 @@ def main() -> int:
     ap.add_argument("--image-root", default="./")
     ap.add_argument("--pack", action="store_true",
                     help="run im2bin on each shard list")
+    ap.add_argument("--shuffle", action="store_true",
+                    help="shuffle rows before splitting (the reference "
+                         "partition-maker's shuffle option)")
+    ap.add_argument("--seed", type=int, default=888)
     args = ap.parse_args()
 
     with open(args.list_file) as f:
         rows = [ln for ln in f if ln.strip()]
+    if args.shuffle:
+        import random
+        random.Random(args.seed).shuffle(rows)
     n = len(rows)
     assert args.nparts >= 1
     shards = []

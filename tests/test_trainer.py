@@ -474,3 +474,42 @@ def test_update_period_with_bf16_grads(tmp_path):
     assert wa.dtype == np.float32
     np.testing.assert_allclose(wa, wb, rtol=0.0, atol=5e-4)
     assert np.isfinite(ta.last_loss) and np.isfinite(tb.last_loss)
+
+
+def test_save_optimizer_seamless_resume(tmp_path):
+    """save_optimizer=1 checkpoints momentum: save@2/load/step ==
+    uninterrupted 3 steps exactly; without it the resumed step differs
+    (the reference never checkpoints momentum — this is the documented
+    improvement, SURVEY §5 checkpoint notes)."""
+    rng = np.random.RandomState(0)
+    batches = [DataBatch(
+        data=rng.rand(16, 256).astype(np.float32),
+        label=rng.randint(0, 4, (16, 1)).astype(np.float32))
+        for _ in range(3)]
+    conf = MLP_CONF.replace("batch_size = 50", "batch_size = 16")
+
+    def run(extra, resume_opt):
+        t = NetTrainer(parse_config(conf) + extra)
+        t.init_model()
+        t.update(batches[0])
+        t.update(batches[1])
+        p = str(tmp_path / ("m_%d.npz" % resume_opt))
+        t.save_model(p)
+        t2 = NetTrainer(parse_config(conf) + extra)
+        t2.load_model(p)
+        t2.update(batches[2])
+        return np.asarray(t2.params["fc1"]["wmat"])
+
+    # uninterrupted baseline
+    tb = NetTrainer(parse_config(conf))
+    tb.init_model()
+    for b in batches:
+        tb.update(b)
+    base = np.asarray(tb.params["fc1"]["wmat"])
+
+    with_opt = run([("save_optimizer", "1")], 1)
+    np.testing.assert_array_equal(with_opt, base)
+
+    without = run([], 0)
+    assert not np.allclose(without, base), \
+        "momentum reset should change the resumed step"
