@@ -115,8 +115,15 @@ class NetGraph:
         if first_time:
             self.node_names = ["in"]
             self.node_name_map = {"in": 0, "0": 0}
-        self.defcfg = []
-        self.layercfg = [[] for _ in self.layers] if not first_time else []
+        # a re-configure with NO netconfig block (a pred/extract conf
+        # against a loaded model — the reference reads layer params from
+        # the model file, nnet_config.h:150-189) keeps the saved
+        # per-layer params AND in-net defaults instead of wiping them
+        has_netconfig = any(n == "netconfig" for n, _ in cfg)
+        if first_time or has_netconfig:
+            self.defcfg = []
+            if not first_time:
+                self.layercfg = [[] for _ in self.layers]
 
         netcfg_mode = 0     # 0: outside, 1: in netconfig, 2: after a layer line
         cfg_top_node = 0
@@ -188,6 +195,8 @@ class NetGraph:
                 "primary_layer_index": l.primary_layer_index,
             } for l in self.layers],
             "layer_name_map": dict(self.layer_name_map),
+            "layercfg": [[list(p) for p in lc] for lc in self.layercfg],
+            "defcfg": [list(p) for p in self.defcfg],
             "input_shape": list(self.input_shape),
             "extra_data_num": self.extra_data_num,
             "extra_shape": [list(s) for s in self.extra_shape],
@@ -207,7 +216,10 @@ class NetGraph:
                               nindex_out=list(l["nindex_out"]),
                               primary_layer_index=l["primary_layer_index"])
                     for l in d["layers"]]
-        g.layercfg = [[] for _ in g.layers]
+        g.layercfg = [[tuple(p) for p in lc]
+                      for lc in d.get("layercfg",
+                                      [[] for _ in d["layers"]])]
+        g.defcfg = [tuple(p) for p in d.get("defcfg", [])]
         g.layer_name_map = dict(d["layer_name_map"])
         g.input_shape = tuple(d["input_shape"])
         g.extra_data_num = d.get("extra_data_num", 0)

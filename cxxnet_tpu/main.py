@@ -217,7 +217,15 @@ class LearnTask:
             trainer.load_model(self.model_in)
             if self.task == "pred":
                 return self._task_predict(trainer, pred_iter or itr_train)
-            if self.task == "extract_feature":
+            if self.task in ("extract_feature", "extract",
+                             "pred_raw"):
+                # "extract" is the reference task name
+                # (cxxnet_main.cpp:115); "pred_raw" appears in the
+                # reference kaggle_bowl pred.conf meaning a raw
+                # probability dump = extract of the top node
+                if self.task == "pred_raw" and \
+                        not self.extract_node_name:
+                    self.extract_node_name = "top"
                 return self._task_extract(trainer, pred_iter or itr_train)
             if self.task == "get_weight":
                 return self._task_get_weight(trainer)
@@ -270,9 +278,9 @@ class LearnTask:
                 # 149-154): every device replica must hold identical
                 # weights
                 trainer.check_weight_consistency()
-            if self.save_period and (r + 1) % self.save_period == 0 \
-                    and is_root():
-                # open_stream creates local dirs; remote URIs need none
+            if self.save_period and (r + 1) % self.save_period == 0:
+                # all ranks call (ZeRO-state gathers are collective);
+                # save_model writes on root only
                 trainer.save_model(self._model_path(r + 1))
         if self.silent == 0 and is_root():
             print("updating end, %ld sec in all"

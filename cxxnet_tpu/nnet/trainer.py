@@ -689,6 +689,10 @@ class NetTrainer:
         }
         arrays["__meta__"] = np.frombuffer(
             json.dumps(meta).encode(), np.uint8)
+        # multi-process: every rank participates in the gathers above
+        # (call save_model on ALL ranks); only root touches the file
+        if jax.process_index() != 0:
+            return
         with open_stream(path, "wb") as f:
             np.savez(f, **arrays)
 

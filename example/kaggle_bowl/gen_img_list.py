@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Build a shuffled image list for the Kaggle NDSB plankton example.
+
+Port of the reference's gen_img_list.py (python2) to the same CLI:
+
+  python gen_img_list.py train sampleSubmission.csv data/train/ train.lst
+  python gen_img_list.py test  sampleSubmission.csv data/test/  test.lst
+
+train: one subdirectory per class, ordered by the submission header.
+test: a flat directory (label column written as 0).
+Rows are "index<TAB>label<TAB>path", shuffled with the reference's
+fixed seed.
+"""
+
+import csv
+import os
+import random
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) < 5:
+        print(__doc__)
+        return 1
+    random.seed(888)
+    task, sub_csv, folder, out = sys.argv[1:5]
+    if not folder.endswith("/"):
+        folder += "/"
+    with open(sub_csv) as f:
+        head = next(csv.reader(f))[1:]          # class columns
+
+    img_lst = []
+    cnt = 0
+    if task == "train":
+        for i, cls in enumerate(head):
+            path = folder + cls
+            for img in sorted(os.listdir(path)):
+                img_lst.append((cnt, i, path + "/" + img))
+                cnt += 1
+    else:
+        for img in sorted(os.listdir(folder)):
+            img_lst.append((cnt, 0, folder + img))
+            cnt += 1
+
+    random.shuffle(img_lst)
+    with open(out, "w") as f:
+        w = csv.writer(f, delimiter="\t", lineterminator="\n")
+        for item in img_lst:
+            w.writerow(item)
+    print("%s: %d images" % (out, cnt))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

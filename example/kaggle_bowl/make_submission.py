@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Assemble a Kaggle NDSB submission csv from a raw probability dump.
+
+Port of the reference's make_submission.py:
+
+  python make_submission.py sampleSubmission.csv test.lst test.txt out.csv
+
+test.txt is the space-separated per-class probability rows written by
+``task=pred_raw`` (extract of the softmax node) over test.lst, in list
+order; each output row is "<image name>,<p_0>,...,<p_{C-1}>".
+"""
+
+import csv
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) < 5:
+        print(__doc__)
+        return 1
+    sub_csv, lst_path, prob_path, out = sys.argv[1:5]
+    with open(sub_csv) as f:
+        head = next(csv.reader(f))
+
+    names = []
+    with open(lst_path) as f:
+        for line in csv.reader(f, delimiter="\t"):
+            if line:
+                names.append(line[-1].rsplit("/", 1)[-1])
+
+    n = 0
+    with open(prob_path) as fi, open(out, "w") as fo:
+        w = csv.writer(fo, lineterminator="\n")
+        w.writerow(head)
+        for line in fi:
+            probs = line.split()
+            if not probs:
+                continue
+            assert len(probs) == len(head) - 1, \
+                "row width %d != %d classes" % (len(probs),
+                                                len(head) - 1)
+            w.writerow([names[n]] + probs)
+            n += 1
+    assert n == len(names), \
+        "probability rows (%d) != images in list (%d)" % (n, len(names))
+    print("%s: %d rows" % (out, n))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

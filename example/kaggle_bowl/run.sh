@@ -1,0 +1,26 @@
+#!/bin/bash
+# Kaggle NDSB plankton example, end to end:
+#   data/train/<class>/*.jpg + sampleSubmission.csv  ->  submission.csv
+# Without the Kaggle data present, synthesizes a tiny stand-in dataset
+# so the full chain (list gen -> train -> pred_raw -> submission) runs.
+set -e
+cd "$(dirname "$0")"
+REPO="$(cd ../.. && pwd)"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+
+if [ ! -d data/train ]; then
+    echo "no data/train found: synthesizing a small stand-in dataset"
+    python synth_data.py
+fi
+
+python gen_img_list.py train sampleSubmission.csv data/train/ train.lst
+python gen_img_list.py test  sampleSubmission.csv data/test/  test.lst
+mkdir -p models
+
+python -m cxxnet_tpu.main bowl.conf "$@"
+
+LAST=$(ls models/*.model.npz | sort | tail -1)
+python -m cxxnet_tpu.main pred.conf model_in="$LAST"
+python make_submission.py sampleSubmission.csv test.lst test.txt \
+    submission.csv
+echo "wrote submission.csv"

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Synthesize a tiny NDSB-shaped dataset (no Kaggle download needed).
+
+Writes data/train/<class>/*.jpg, data/test/*.jpg and
+sampleSubmission.csv so run.sh exercises the full example chain in an
+offline environment. Classes are distinguishable blob patterns, so a
+short training run beats chance.
+"""
+
+import csv
+import os
+
+import numpy as np
+
+NCLASS = 121        # match the real class count (bowl.conf nhidden)
+PER_CLASS = 4
+NTEST = 32
+
+
+def main() -> int:
+    import cv2
+    rng = np.random.RandomState(0)
+    classes = ["plankton_%03d" % i for i in range(NCLASS)]
+    os.makedirs("data/test", exist_ok=True)
+    for ci, cls in enumerate(classes):
+        d = os.path.join("data", "train", cls)
+        os.makedirs(d, exist_ok=True)
+        for j in range(PER_CLASS):
+            img = rng.randint(0, 40, (48, 48), np.uint8)
+            # class signature: a bright blob at a class-specific spot
+            y, x = 3 + 3 * (ci % 11), 3 + 3 * (ci // 11)
+            img[y:y + 10, x:x + 10] = 220 - rng.randint(0, 30)
+            cv2.imwrite(os.path.join(d, "img%03d.jpg" % j), img)
+    for j in range(NTEST):
+        ci = rng.randint(NCLASS)
+        img = rng.randint(0, 40, (48, 48), np.uint8)
+        y, x = 3 + 3 * (ci % 11), 3 + 3 * (ci // 11)
+        img[y:y + 10, x:x + 10] = 220 - rng.randint(0, 30)
+        cv2.imwrite(os.path.join("data", "test", "t%03d.jpg" % j), img)
+    with open("sampleSubmission.csv", "w") as f:
+        w = csv.writer(f, lineterminator="\n")
+        w.writerow(["image"] + classes)
+    print("synthesized %d classes x %d train, %d test"
+          % (NCLASS, PER_CLASS, NTEST))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

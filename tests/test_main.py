@@ -154,3 +154,33 @@ def test_extract_layer_name_is_get_weight_alias(setup, capsys):
     assert main([conf, "task=get_weight", "extract_layer_name=fc1",
                  "model_in=" + model, "weight_filename=" + wfile]) == 0
     assert np.loadtxt(wfile).shape == (32, 256)
+
+
+def test_pred_raw_and_conf_without_netconfig(setup, capsys, tmp_path):
+    """task=pred_raw dumps per-class probabilities, and a pred conf
+    WITHOUT a netconfig block works against a loaded model (the
+    reference reads layer params from the model file; see the
+    kaggle_bowl pred.conf)."""
+    tp, conf = setup
+    assert main([conf, "num_round=1"]) == 0
+    model = str(tp / "models" / "0001.model.npz")
+
+    # minimal pred-style conf: data block + globals, NO netconfig
+    pimg, plab = synth_idx(str(tp), n=100, seed=9, name="pr")
+    mini = tp / "mini.conf"
+    mini.write_text("""
+pred = %s
+iter = mnist
+  path_img = "%s"
+  path_label = "%s"
+  silent = 1
+iter = end
+task = pred_raw
+input_shape = 1,1,256
+batch_size = 50
+model_in = %s
+""" % (tp / "probs.txt", pimg, plab, model))
+    assert main([str(mini)]) == 0
+    probs = np.loadtxt(tp / "probs.txt")
+    assert probs.shape == (100, 4)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-3)
