@@ -63,3 +63,39 @@ def test_split_sections_imagenet():
     assert len(blocks) >= 2
     kinds = [b["kind"] for b in blocks]
     assert "data" in kinds and "eval" in kinds
+
+
+def test_cli_error_paths(tmp_path, capsys):
+    """Misconfigurations fail fast with readable errors, not stack
+    traces deep in the stack (reference utils::Check style)."""
+    from cxxnet_tpu.main import LearnTask
+    from cxxnet_tpu.io import create_iterator
+
+    # unknown iterator type
+    with pytest.raises(ValueError, match="unknown iterator type"):
+        create_iterator([("iter", "nosuch")], [("batch_size", "4")])
+
+    # adapter without a base iterator
+    with pytest.raises(AssertionError):
+        create_iterator([("iter", "threadbuffer")],
+                        [("batch_size", "4")])
+
+    # unterminated iterator block
+    conf = tmp_path / "bad.conf"
+    conf.write_text("data = train\niter = csv\n  filename = x.csv\n")
+    with pytest.raises(ConfigError, match="not closed"):
+        LearnTask().run([str(conf)])
+
+    # unknown layer type surfaces by name (at net build)
+    from cxxnet_tpu.graph import NetGraph
+    from cxxnet_tpu.nnet.net import FuncNet
+    g = NetGraph()
+    g.configure(parse_config(
+        "netconfig = start\nlayer[0->1] = nosuchlayer\n"
+        "netconfig = end\ninput_shape = 1,1,4\nbatch_size = 2\n"))
+    with pytest.raises(ValueError, match="nosuchlayer"):
+        FuncNet(g, 2)
+
+    # no config file -> usage print + rc 1, not a traceback
+    assert LearnTask().run([]) == 1
+    assert "Usage:" in capsys.readouterr().out
