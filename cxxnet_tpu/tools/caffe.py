@@ -155,3 +155,51 @@ def load_caffe(path: str) -> Dict[str, np.ndarray]:
         raise ValueError(
             "caffe import: no parameterized layers found in %r" % path)
     return out
+
+
+def convert_mean(caffe_mean_path: str, out_npy_path: str) -> np.ndarray:
+    """Convert a caffe mean file (a serialized BlobProto) into the
+    augmenter's ``image_mean`` .npy cache.
+
+    Counterpart of ``tools/caffe_converter/convert_mean.cpp``: the
+    caffe blob is (1, C, H, W) channel-major BGR; the augmenter wants
+    HWC RGB (iter_augment.py mean layout), so channels are transposed
+    and reversed like the reference's BGR re-ordering.
+    """
+    with open_stream(caffe_mean_path, "rb") as f:
+        blob = _parse_blob(memoryview(f.read()))
+    arr = np.asarray(blob, np.float32)
+    if arr.ndim == 4:
+        arr = arr[0]
+    if arr.ndim == 2:                 # grayscale mean: (H, W) -> 1 ch
+        arr = arr[None]
+    if arr.ndim != 3:
+        raise ValueError(
+            "caffe import: mean blob in %r must be (C, H, W); got "
+            "shape %s" % (caffe_mean_path, arr.shape))
+    hwc = arr.transpose(1, 2, 0)[:, :, ::-1]      # CHW BGR -> HWC RGB
+    out = np.ascontiguousarray(hwc, np.float32)
+    with open_stream(out_npy_path, "wb") as f:
+        np.save(f, out)
+    return out
+
+
+def main(argv=None) -> int:
+    """CLI: python -m cxxnet_tpu.tools.caffe <mean.binaryproto> <out.npy>
+
+    (model conversion goes through ``cxxnet_tpu.tools.convert`` with a
+    .caffemodel source; this entry point is the convert_mean binary.)
+    """
+    import sys
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 2:
+        print(main.__doc__)
+        return 1
+    out = convert_mean(argv[0], argv[1])
+    print("convert_mean: %s -> %s %s" % (argv[0], argv[1], out.shape))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -165,3 +165,22 @@ def test_caffemodel_convert_forward_match(fixture_net, tmp_path):
     f2 = t2.extract_feature(batch, "top[-1]")
     np.testing.assert_allclose(f1, f2, rtol=1e-5, atol=1e-6)
     assert p1.shape == (2,)
+
+
+def test_convert_mean(tmp_path):
+    """Caffe mean BlobProto -> augmenter .npy (convert_mean.cpp
+    parity): CHW BGR becomes HWC RGB."""
+    from cxxnet_tpu.tools.caffe import convert_mean
+
+    rng = np.random.RandomState(3)
+    mean_chw = rng.rand(3, 5, 6).astype(np.float32)   # BGR planes
+    p = tmp_path / "mean.binaryproto"
+    p.write_bytes(_blob_legacy(mean_chw[None]))       # (1, C, H, W)
+
+    out_path = tmp_path / "mean.npy"
+    got = convert_mean(str(p), str(out_path))
+    assert got.shape == (5, 6, 3)
+    # channel 0 of the output (R) is caffe channel 2
+    np.testing.assert_allclose(got[:, :, 0], mean_chw[2], rtol=1e-6)
+    np.testing.assert_allclose(got[:, :, 2], mean_chw[0], rtol=1e-6)
+    np.testing.assert_allclose(np.load(out_path), got)
