@@ -153,8 +153,27 @@ class AugmentAdapter(IIterator):
         # SAME mean, and only root writes the cache (no write race)
         from ..parallel import allreduce_host_sum, is_root, world_size
         if world_size() > 1:
+            # a rank with an empty shard must still contribute a zero
+            # array of the TRUE image shape (process_allgather requires
+            # identical shapes); agree on the shape first
+            from jax.experimental import multihost_utils
+            svec = np.zeros((9,), np.int64)
+            if total is not None:
+                svec[0] = total.ndim
+                svec[1:1 + total.ndim] = total.shape
+            shapes = np.asarray(multihost_utils.process_allgather(svec))
+            nz = shapes[shapes[:, 0] > 0]
+            assert len(nz), \
+                "mean image: every rank's data shard is empty"
+            # symmetric check: EVERY rank fails at once on a shape
+            # mismatch (an asymmetric raise would leave the other
+            # ranks hanging in the allreduce below)
+            assert (nz == nz[0]).all(), \
+                "mean image: image shape differs across ranks: %s" \
+                % shapes.tolist()
+            shp = tuple(int(x) for x in nz[0][1:1 + int(nz[0][0])])
             if total is None:
-                total = np.zeros((1,), np.float32)
+                total = np.zeros(shp, np.float32)
             total = allreduce_host_sum(total)
             cnt = int(allreduce_host_sum(
                 np.asarray([cnt], np.float64))[0])

@@ -23,7 +23,8 @@ import numpy as np
 
 from .io import create_iterator
 from .nnet.trainer import NetTrainer
-from .parallel import init_distributed, is_root, world_size
+from .parallel import (init_distributed, is_root, synced_batches,
+                       world_size)
 from .utils.config import (parse_cli_overrides, parse_config_file,
                            split_sections)
 from .utils.stream import list_stream_dir, open_stream, uri_scheme
@@ -53,6 +54,10 @@ class LearnTask:
         self.weight_tag = "wmat"
         self.test_io = 0
         self.device = ""
+        # batches per jitted dispatch in the train loop (update_many):
+        # amortizes host dispatch latency; schedule stays per-update
+        # correct. 1 = per-batch update().
+        self.dispatch_period = 8
 
     # -- config ----------------------------------------------------------
 
@@ -102,6 +107,8 @@ class LearnTask:
             self.test_io = int(val)
         if name == "dev":
             self.device = val
+        if name == "dispatch_period":
+            self.dispatch_period = max(1, int(val))
 
     # -- model files -----------------------------------------------------
 
@@ -179,16 +186,26 @@ class LearnTask:
         # (make_array_from_process_local_data). Rank-disjoint DATA comes
         # from the iterators' own part_index/num_parts sharding.
         nproc = world_size()
-        if nproc > 1:
-            def _local_bs(v: str) -> str:
-                assert int(v) % nproc == 0, \
-                    "batch_size %s must divide evenly across %d " \
-                    "processes" % (v, nproc)
-                return str(int(v) // nproc)
-            batch_cfg = [(k, _local_bs(v) if k == "batch_size" else v)
-                         for k, v in batch_cfg]
+
+        def _local_bs(v: str) -> str:
+            assert int(v) % nproc == 0, \
+                "batch_size %s must divide evenly across %d " \
+                "processes" % (v, nproc)
+            return str(int(v) // nproc)
+
+        def _localize(pairs):
+            """Divide every batch_size by world_size — both the global
+            section AND iterator-block overrides (a block-level
+            batch_size applied after the divided global one would feed
+            world_size-times-too-many rows into the global assembly)."""
+            if nproc == 1:
+                return pairs
+            return [(k, _local_bs(v) if k == "batch_size" else v)
+                    for k, v in pairs]
+
+        batch_cfg = _localize(batch_cfg)
         for b in blocks:
-            it = create_iterator(b["cfg"], batch_cfg)
+            it = create_iterator(_localize(b["cfg"]), batch_cfg)
             it.init()
             all_iters.append(it)
             if b["kind"] == "data":
@@ -254,17 +271,35 @@ class LearnTask:
             # device compute by device_put-ing in the prefetch thread
             itr_train.set_transform(trainer.device_put_batch)
         start = time.time()
+        k = self.dispatch_period
+
+        def _progress(r, nbatch):
+            if (self.print_step and nbatch % self.print_step < k
+                    and self.silent == 0 and is_root()):
+                print("round %8d:[%8d] %ld sec elapsed"
+                      % (r, nbatch, int(time.time() - start)))
+
         for r in range(self.start_counter - 1, self.num_round):
             trainer.start_round(r)
             nbatch = 0
-            for batch in itr_train:
-                trainer.update(batch)
+            window = []
+            # lockstep across ranks: unequal per-rank batch counts would
+            # deadlock the SPMD collectives (see parallel.synced_batches)
+            for batch in synced_batches(itr_train, window=k):
+                if k == 1:
+                    trainer.update(batch)
+                    nbatch += 1
+                else:
+                    window.append(batch)
+                    if len(window) < k:
+                        continue
+                    trainer.update_many(window)
+                    nbatch += len(window)
+                    window = []
+                _progress(r, nbatch)
+            for batch in window:        # round tail: per-batch (a short
+                trainer.update(batch)   # window would recompile)
                 nbatch += 1
-                if (self.print_step and nbatch % self.print_step == 0
-                        and self.silent == 0 and is_root()):
-                    elapsed = time.time() - start
-                    print("round %8d:[%8d] %ld sec elapsed"
-                          % (r, nbatch, int(elapsed)))
             line = "[%d]" % (r + 1)
             if self.task_eval_train:
                 line += trainer.train_metric_str("train")

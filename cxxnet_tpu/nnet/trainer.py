@@ -84,7 +84,6 @@ class NetTrainer:
         self.update_counter = 0          # applied updates (schedule epoch)
         self.round = 0
         self._initialized = False
-        self._warned_scan_schedule = False
 
     # -- config ----------------------------------------------------------
 
@@ -278,34 +277,49 @@ class NetTrainer:
             return jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), grads)
 
-        def train_step(params, opt_state, net_state, grad_acc,
-                       data, labels, mask, extra, hyper_arr, step,
-                       base_key, do_update):
-            # step rides as its own uint32 scalar — packing it into the
-            # float32 hyper array silently rounded past 2^24 steps,
-            # repeating dropout/insanity RNG streams on long runs
+        def scan_step(params, opt_state, net_state, grad_acc,
+                      data, labels, mask, extra, hyper_row, do_up,
+                      step, base_key, collect):
+            """The ONE train-step body all dispatch paths share
+            (update / update_many / run_steps — a single definition so
+            the math cannot drift between them). do_up may be traced
+            (scan windows) or a static bool (per-batch update); the
+            hyper row is per-step so the LR/momentum schedule advances
+            inside scanned dispatches. ``step`` rides as its own uint32
+            scalar — packing it into the float32 hyper array silently
+            rounded past 2^24 steps, repeating dropout/insanity RNG
+            streams on long runs."""
             rng = jax.random.fold_in(base_key, step)
             (loss, (new_state, preds)), grads = jax.value_and_grad(
                 net.loss_fn, has_aux=True)(
                     _grad_cast(params), net_state, data, labels, mask,
                     extra=extra, rng=rng, collect_nodes=metric_nodes)
-            preds = [p.astype(jnp.float32) for p in preds]
+            preds = [p.astype(jnp.float32) for p in preds] if collect \
+                else []
             if update_period == 1:
                 params, opt_state = apply_updates(
-                    params, opt_state, _grad_f32(grads), hyper_arr)
-                return params, opt_state, new_state, grad_acc, loss, preds
+                    params, opt_state, _grad_f32(grads), hyper_row)
+                return (params, opt_state, new_state, grad_acc, loss,
+                        preds)
             # accumulate in f32 regardless of gradient dtype
             grad_acc = _tree_add(grad_acc, _grad_f32(grads))
 
             def do_apply(args):
                 p, o, acc = args
-                p2, o2 = apply_updates(p, o, acc, hyper_arr)
+                p2, o2 = apply_updates(p, o, acc, hyper_row)
                 return p2, o2, _tree_zeros_like(acc)
 
             params, opt_state, grad_acc = jax.lax.cond(
-                do_update, do_apply, lambda a: a,
+                do_up, do_apply, lambda a: a,
                 (params, opt_state, grad_acc))
             return params, opt_state, new_state, grad_acc, loss, preds
+
+        def train_step(params, opt_state, net_state, grad_acc,
+                       data, labels, mask, extra, hyper_arr, step,
+                       base_key, do_update):
+            return scan_step(params, opt_state, net_state, grad_acc,
+                             data, labels, mask, extra, hyper_arr,
+                             do_update, step, base_key, True)
 
         donate = (0, 1, 3) if update_period > 1 else (0, 1)
         # pin output shardings: without this, GSPMD propagation from the
@@ -322,26 +336,62 @@ class NetTrainer:
                                    out_shardings=out_shardings)
 
         def multi_step(params, opt_state, net_state, data, labels, mask,
-                       extra, hyper_arr, step, base_key, n_steps):
+                       extra, hyper_k, step, base_key):
             """n_steps full update steps in ONE dispatch (lax.scan over
             the same resident batch) — host dispatch latency amortizes
-            to zero; LR/epoch are frozen across the window."""
-            def body(carry, i):
+            to zero. hyper_k is (n_steps, n_updaters, 4): the schedule
+            advances per step in-scan."""
+            def body(carry, xs):
                 p, o, s = carry
-                p, o, s, _, loss, _ = train_step(
-                    p, o, s, None, data, labels, mask, extra, hyper_arr,
-                    step + i, base_key, do_update=True)
+                hyper_i, i = xs
+                p, o, s, _, loss, _ = scan_step(
+                    p, o, s, None, data, labels, mask, extra, hyper_i,
+                    True, step + i, base_key, False)
                 return (p, o, s), loss
+            n = hyper_k.shape[0]
             (params, opt_state, net_state), losses = jax.lax.scan(
                 body, (params, opt_state, net_state),
-                jnp.arange(n_steps, dtype=jnp.uint32))
+                (hyper_k, jnp.arange(n, dtype=jnp.uint32)))
             return params, opt_state, net_state, losses[-1]
 
         self._multi_step = jax.jit(
             multi_step, donate_argnums=(0, 1),
-            static_argnames=("n_steps",),
             out_shardings=(self._p_shard, self._o_shard, ns_shard,
                            self._repl))
+
+        # K-batch window sharding: leading axis = scan step, batch rows
+        # sharded on 'data' as usual
+        self._kb_shard = NamedSharding(mesh, P(None, "data"))
+        self._stack_k = jax.jit(lambda *xs: jnp.stack(xs),
+                                out_shardings=self._kb_shard)
+
+        def many_step(params, opt_state, net_state, grad_acc,
+                      data_k, labels_k, mask_k, extra_k, hyper_k,
+                      do_up_k, step, base_key, collect):
+            """K REAL batches in one dispatch: scan over the stacked
+            window. Schedule-correct (per-step hyper rows) and
+            update_period-correct (traced apply flags)."""
+            def body(carry, xs):
+                p, o, s, acc = carry
+                data, labels, mask, extra, hyper_i, do_up, i = xs
+                p, o, s, acc, loss, preds = scan_step(
+                    p, o, s, acc, data, labels, mask, extra, hyper_i,
+                    do_up, step + i, base_key, collect)
+                return (p, o, s, acc), (loss, preds)
+            K = hyper_k.shape[0]
+            carry, (losses, preds_k) = jax.lax.scan(
+                body, (params, opt_state, net_state, grad_acc),
+                (data_k, labels_k, mask_k, extra_k, hyper_k, do_up_k,
+                 jnp.arange(K, dtype=jnp.uint32)))
+            params, opt_state, net_state, grad_acc = carry
+            return (params, opt_state, net_state, grad_acc, losses[-1],
+                    preds_k)
+
+        self._many_step = jax.jit(
+            many_step, donate_argnums=donate,
+            static_argnames=("collect",),
+            out_shardings=(self._p_shard, self._o_shard, ns_shard,
+                           acc_shard, self._repl, self._kb_shard))
 
         def pred_step(params, net_state, data, mask, extra,
                       nodes_wanted):
@@ -358,9 +408,10 @@ class NetTrainer:
 
     # -- hyper-params per step ------------------------------------------
 
-    def _hyper(self) -> np.ndarray:
+    def _hyper(self, epoch: Optional[int] = None) -> np.ndarray:
         """Packed (n_updaters, 4) array: lr, momentum, wd, epoch."""
-        epoch = self.update_counter
+        if epoch is None:
+            epoch = self.update_counter
         arr = np.zeros((len(self._hyper_index), 4), np.float32)
         for i, (lk, tag) in enumerate(self._hyper_index):
             upd = self.updaters[lk][tag]
@@ -440,23 +491,33 @@ class NetTrainer:
     def _device_extra(self, batch: DataBatch):
         return tuple(self._put_batch_array(e) for e in batch.extra_data)
 
-    def _local_rows(self, arr, flatten: bool = True) -> np.ndarray:
+    def _local_rows(self, arr, flatten: bool = True,
+                    axis: int = 0) -> np.ndarray:
         """Fetch this process's rows of a batch-sharded output.
 
         Single-process: the whole array. Multi-process dp: concatenate
-        the addressable shards in global row order, which is exactly the
-        order of this rank's local input rows
-        (make_array_from_process_local_data splits the local batch over
-        local devices in ascending mesh position). ``flatten`` returns
-        the as_mat 2-D view."""
+        the addressable shards in global row order along the batch
+        ``axis`` (0 for per-batch outputs, 1 for K-window outputs whose
+        leading axis is the scan step), which is exactly the order of
+        this rank's local input rows (make_array_from_process_local_data
+        splits the local batch over local devices in ascending mesh
+        position). Shards are deduped by row range: with a model axis
+        >1, batch-sharded outputs are replicated across 'model', so
+        each row slice appears once per model-axis device. ``flatten``
+        collapses the trailing dims to the as_mat 2-D view."""
         if jax.process_count() == 1:
             out = np.asarray(arr)
         else:
-            shards = sorted(arr.addressable_shards,
-                            key=lambda s: s.index[0].start or 0)
-            out = np.concatenate([np.asarray(s.data) for s in shards],
-                                 axis=0)
-        return out.reshape(out.shape[0], -1) if flatten else out
+            uniq = {}
+            for s in arr.addressable_shards:
+                uniq.setdefault(s.index[axis].start or 0, s)
+            out = np.concatenate(
+                [np.asarray(uniq[k].data) for k in sorted(uniq)],
+                axis=axis)
+        if not flatten:
+            return out
+        lead = out.shape[:axis + 1]
+        return out.reshape(lead + (-1,))
 
     # -- public API ------------------------------------------------------
 
@@ -467,12 +528,17 @@ class NetTrainer:
         assert self._initialized, "call init_model/load_model first"
         data, labels, mask, extra = self._device_batch(batch)
         hyper = self._hyper()
+        # step BEFORE the counter bump: batch i of the run folds RNG
+        # with step U*period+S (0-based), the same index scan_step uses
+        # as step0+i — so dropout/insanity masks are identical whether
+        # batches go through update(), update_many, or run_steps
+        step = self._step_scalar()
         self.sample_counter += 1
         do_update = self.sample_counter >= self.update_period
         out = self._train_step(self.params, self.opt_state,
                                self.net_state, self.grad_acc,
                                data, labels, mask, extra, hyper,
-                               self._step_scalar(), self._base_key,
+                               step, self._base_key,
                                do_update=bool(do_update))
         (self.params, self.opt_state, self.net_state,
          self.grad_acc, loss, preds) = out
@@ -490,28 +556,74 @@ class NetTrainer:
     def run_steps(self, batch: DataBatch, n_steps: int) -> None:
         """Run n_steps full update steps on one resident batch in a
         single dispatch (steady-state throughput measurement — the
-        test_skipread mode, iter_batch_proc-inl.hpp:21).
-
-        LR/momentum are evaluated ONCE for the window: a non-constant
-        schedule does not advance inside the scan, so for real training
-        across schedule boundaries use ``update()`` per step."""
+        test_skipread mode, iter_batch_proc-inl.hpp:21). The LR/momentum
+        schedule advances per step in-scan via a per-step hyper array
+        (reference applies ScheduleEpoch every update, updater/param.h:
+        96-117)."""
         assert self._initialized and self.update_period == 1
-        if self.silent == 0 and not self._warned_scan_schedule and any(
-                u.param.lr_schedule != 0
-                for tags in self.updaters.values()
-                for u in tags.values()):
-            self._warned_scan_schedule = True
-            print("run_steps: non-constant lr schedule is frozen for "
-                  "the %d-step scan window" % n_steps)
         data, labels, mask, extra = self._device_batch(batch)
+        hyper_k = np.stack([self._hyper(self.update_counter + i)
+                            for i in range(int(n_steps))])
         out = self._multi_step(self.params, self.opt_state,
                                self.net_state, data, labels, mask,
-                               extra, self._hyper(),
-                               self._step_scalar(), self._base_key,
-                               n_steps=int(n_steps))
+                               extra, hyper_k,
+                               self._step_scalar(), self._base_key)
         (self.params, self.opt_state, self.net_state, loss) = out
         self._last_loss = loss
         self.update_counter += n_steps
+
+    def update_many(self, batches: Sequence[DataBatch]) -> None:
+        """Train on K real batches in ONE jitted dispatch: host dispatch
+        latency amortizes across the window while the schedule stays
+        per-update correct (hyper rows advance in-scan) and
+        update_period accumulation windows close in-scan (traced apply
+        flags). Observable semantics are identical to K ``update()``
+        calls — proven by an equality test across an LR-schedule
+        boundary.
+
+        The throughput intent of the reference's threadbuffer overlap
+        (iter_batch_proc-inl.hpp:132-220) at the per-batch ScheduleEpoch
+        semantics of updater/param.h:96-117."""
+        assert self._initialized, "call init_model/load_model first"
+        K = len(batches)
+        if K == 1:
+            return self.update(batches[0])
+        period = self.update_period
+        S, U = self.sample_counter, self.update_counter
+        hyper_k = np.stack([self._hyper(U + (S + i) // period)
+                            for i in range(K)])
+        do_up = np.asarray([((S + i + 1) % period) == 0
+                            for i in range(K)])
+        step0 = self._step_scalar()
+        data_k = self._stack_k(*[self._put_batch_array(b.data)
+                                 for b in batches])
+        labels_k = self._stack_k(*[self._put_batch_array(b.label)
+                                   for b in batches])
+        mask_k = self._stack_k(*[self._put_batch_array(self._mask(b))
+                                 for b in batches])
+        n_extra = len(batches[0].extra_data)
+        extra_k = tuple(
+            self._stack_k(*[self._put_batch_array(b.extra_data[j])
+                            for b in batches])
+            for j in range(n_extra))
+        collect = bool(self.eval_train and self._metrics.evals)
+        out = self._many_step(self.params, self.opt_state,
+                              self.net_state, self.grad_acc,
+                              data_k, labels_k, mask_k, extra_k,
+                              hyper_k, do_up, step0, self._base_key,
+                              collect=collect)
+        (self.params, self.opt_state, self.net_state, self.grad_acc,
+         loss, preds_k) = out
+        self._last_loss = loss
+        self.update_counter = U + (S + K) // period
+        self.sample_counter = (S + K) % period
+        if collect:
+            preds_np = [self._local_rows(p, axis=1) for p in preds_k]
+            for i, b in enumerate(batches):
+                nvalid = self._local_batch_size(b) - b.num_batch_padd
+                self._train_metrics.add_eval(
+                    [p[i][:nvalid] for p in preds_np],
+                    self._label_fields(self._host_label(b), nvalid))
 
     def train_metric_str(self, name: str = "train") -> str:
         s = self._train_metrics.print_str(name)
@@ -524,7 +636,8 @@ class NetTrainer:
             return ""
         self._metrics.clear()
         nodes_wanted = tuple(self._metric_nodes)
-        for batch in data_iter:
+        from ..parallel import synced_batches
+        for batch in synced_batches(data_iter, window=8):
             # same input path as training: uint8 pixels ship raw (1/4
             # the H2D bytes) and pre-placed prefetch batches pass
             # through (reference evaluates through the training pipeline,

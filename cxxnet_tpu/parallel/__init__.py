@@ -170,6 +170,41 @@ def allreduce_host_sum(x: np.ndarray) -> np.ndarray:
         multihost_utils.process_allgather(x).sum(axis=0))
 
 
+def synced_batches(it, window: int = 1):
+    """Iterate a per-rank data iterator in lockstep across processes.
+
+    Under multi-process dp, rank-strided sharding can leave ranks with
+    local row counts differing by one; when that crosses a local-batch
+    multiple, ranks would emit different batch counts and the SPMD
+    collectives inside the train/eval step would deadlock. Each rank
+    buffers up to ``window`` batches, allgathers its available count
+    (ONE host collective per window — pass the train loop's
+    dispatch_period to amortize), and the loop yields the cross-rank
+    minimum, stopping when any rank comes up short; a richer rank drops
+    at most its last ``window`` tail batches per round. Single-process:
+    passthrough with zero overhead.
+    """
+    if jax.process_count() == 1:
+        yield from it
+        return
+    from jax.experimental import multihost_utils
+    src = iter(it)
+    while True:
+        buf = []
+        while len(buf) < window:
+            try:
+                buf.append(next(src))
+            except StopIteration:
+                break
+        counts = np.asarray(multihost_utils.process_allgather(
+            np.asarray([len(buf)], np.int32)))
+        nmin = int(counts.min())
+        for b in buf[:nmin]:
+            yield b
+        if nmin < window:
+            return
+
+
 def rank() -> int:
     return jax.process_index()
 

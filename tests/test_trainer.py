@@ -513,3 +513,103 @@ def test_save_optimizer_seamless_resume(tmp_path):
     without = run([], 0)
     assert not np.allclose(without, base), \
         "momentum reset should change the resumed step"
+
+
+# ---------------------------------------------------------------------------
+# update_many: K-batch scanned dispatch == K update() calls, including
+# across an LR-schedule boundary and through update_period windows
+# (the round-4 schedule-correct amortized training path).
+# ---------------------------------------------------------------------------
+
+SCHED_EXTRA = [("lr:schedule", "expdecay"), ("lr:step", "2"),
+               ("lr:gamma", "0.5"), ("eval_train", "1")]
+
+
+def _rand_batches(n, bs=50, seed=0):
+    rng = np.random.RandomState(seed)
+    return [DataBatch(data=rng.rand(bs, 256).astype(np.float32),
+                      label=rng.randint(0, 4, (bs, 1)).astype(np.float32))
+            for _ in range(n)]
+
+
+def test_update_many_matches_updates_across_schedule():
+    """6 batches through one update_many == 6 update() calls; the
+    expdecay schedule (lr:step=2) halves the LR twice INSIDE the
+    window, so frozen-schedule dispatch would diverge."""
+    batches = _rand_batches(6)
+    ta = make_trainer(MLP_CONF, extra=SCHED_EXTRA)
+    tb = make_trainer(MLP_CONF, extra=SCHED_EXTRA)
+    ta.update_many(batches)
+    for b in batches:
+        tb.update(b)
+    assert ta.update_counter == tb.update_counter == 6
+    for lk in ta.params:
+        for tag in ta.params[lk]:
+            np.testing.assert_allclose(
+                np.asarray(ta.params[lk][tag]),
+                np.asarray(tb.params[lk][tag]), rtol=1e-6, atol=1e-7,
+                err_msg="param %s:%s diverged across the schedule "
+                        "boundary" % (lk, tag))
+    # train metrics match too (same preds collected in-scan)
+    assert ta.train_metric_str() == tb.train_metric_str()
+
+
+def test_update_many_update_period_windows():
+    """update_period=2 accumulation windows close IN-SCAN (traced apply
+    flags): K=4 scanned == 4 per-batch updates, and a window that
+    leaves sample_counter mid-period hands off to update() correctly."""
+    extra = SCHED_EXTRA + [("update_period", "2")]
+    batches = _rand_batches(6, seed=3)
+    ta = make_trainer(MLP_CONF, extra=extra)
+    tb = make_trainer(MLP_CONF, extra=extra)
+    # K=4 (two full windows), then K=1 fallback, then update() — ends
+    # mid-period on both sides
+    ta.update_many(batches[:4])
+    ta.update_many(batches[4:5])
+    ta.update(batches[5])
+    for b in batches:
+        tb.update(b)
+    assert ta.update_counter == tb.update_counter == 3
+    assert ta.sample_counter == tb.sample_counter == 0
+    for lk in ta.params:
+        for tag in ta.params[lk]:
+            np.testing.assert_allclose(
+                np.asarray(ta.params[lk][tag]),
+                np.asarray(tb.params[lk][tag]), rtol=1e-6, atol=1e-7)
+
+
+def test_run_steps_schedule_advances():
+    """run_steps is now schedule-correct: n scanned steps on one batch
+    == n update() calls on that same batch under a decaying LR."""
+    (b,) = _rand_batches(1, seed=5)
+    ta = make_trainer(MLP_CONF, extra=SCHED_EXTRA + [("eval_train", "0")])
+    tb = make_trainer(MLP_CONF, extra=SCHED_EXTRA + [("eval_train", "0")])
+    ta.run_steps(b, 5)
+    for _ in range(5):
+        tb.update(b)
+    assert ta.update_counter == tb.update_counter == 5
+    np.testing.assert_allclose(np.asarray(ta.params["fc1"]["wmat"]),
+                               np.asarray(tb.params["fc1"]["wmat"]),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_update_many_matches_updates_with_dropout():
+    """RNG-stream parity: a net WITH dropout must produce identical
+    params whether batches go through update_many or update() — i.e.
+    the in-scan step index matches update()'s fold_in exactly (the
+    round-4 review's off-by-one finding)."""
+    conf = MLP_CONF.replace("layer[+1] = relu",
+                            "layer[+1] = relu\nlayer[+0] = dropout\n"
+                            "  threshold = 0.5")
+    batches = _rand_batches(4, seed=9)
+    ta = make_trainer(conf, extra=[("eval_train", "0")])
+    tb = make_trainer(conf, extra=[("eval_train", "0")])
+    ta.update_many(batches[:3])          # window + per-batch handoff
+    ta.update(batches[3])
+    for b in batches:
+        tb.update(b)
+    np.testing.assert_allclose(np.asarray(ta.params["fc1"]["wmat"]),
+                               np.asarray(tb.params["fc1"]["wmat"]),
+                               rtol=1e-6, atol=1e-7,
+                               err_msg="dropout masks differ between "
+                                       "scanned and per-batch dispatch")
