@@ -105,7 +105,8 @@ def _make_raw_rec(path: str, n: int = 2048, size: int = 256) -> None:
 
 
 def measure_pipeline(batch: int = 256, rec_path: str = "/tmp/bench.rec",
-                     n_images: int = 2048, raw: bool = False):
+                     n_images: int = 2048, raw: bool = False,
+                     dispatch_period: int = 8):
     """End-to-end throughput: imgrec -> decode pool -> augment (rand
     crop 227 + mirror) -> batch -> threadbuffer prefetch -> device
     train step. Returns (img/s end-to-end, duty cycle vs pure compute,
@@ -140,23 +141,30 @@ def measure_pipeline(batch: int = 256, rec_path: str = "/tmp/bench.rec",
     if hasattr(it, "set_transform"):
         it.set_transform(t.device_put_batch)  # H2D in prefetch thread
 
-    # warmup epoch fragment: compile + fill prefetch
-    it.before_first()
-    nwarm = 0
-    for b in it:
-        t.update(b)
-        nwarm += 1
-        if nwarm >= 4:
-            break
-    _ = t.last_loss
+    def run_epoch(max_batches=None):
+        """The CLI train loop's windowed dispatch (update_many every
+        dispatch_period batches, per-batch tail)."""
+        n, window = 0, []
+        it.before_first()
+        for b in it:
+            window.append(b)
+            n += b.batch_size - b.num_batch_padd
+            if len(window) >= dispatch_period:
+                t.update_many(window)
+                window = []
+            if max_batches and n >= max_batches * batch:
+                break
+        for b in window:
+            t.update(b)
+        _ = t.last_loss
+        return n
+
+    # warmup epoch fragment: compile (window + tail paths) + fill
+    # prefetch
+    run_epoch(max_batches=dispatch_period + 1)
 
     start = time.perf_counter()
-    nimg = 0
-    it.before_first()
-    for b in it:
-        t.update(b)
-        nimg += b.batch_size - b.num_batch_padd
-    _ = t.last_loss
+    nimg = run_epoch()
     dt = time.perf_counter() - start
     e2e = nimg / dt
 

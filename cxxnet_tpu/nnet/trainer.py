@@ -491,6 +491,23 @@ class NetTrainer:
     def _device_extra(self, batch: DataBatch):
         return tuple(self._put_batch_array(e) for e in batch.extra_data)
 
+    def _put_window(self, arrs) -> jnp.ndarray:
+        """Place a K-batch window as ONE (K, batch, ...) array sharded
+        (None, 'data'). Host arrays stack host-side and ship in a
+        single transfer (K separate device_puts cost K dispatch round
+        trips); device-resident arrays (prefetch-transform batches,
+        test_skipread) stack device-side."""
+        if any(isinstance(a, jax.Array) for a in arrs):
+            return self._stack_k(*[self._put_batch_array(a)
+                                   for a in arrs])
+        stacked = np.stack([np.asarray(a) for a in arrs])
+        if stacked.dtype != np.uint8:     # u8 pixels ship raw
+            stacked = np.asarray(stacked, np.float32)
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                self._kb_shard, stacked)
+        return jax.device_put(stacked, self._kb_shard)
+
     def _local_rows(self, arr, flatten: bool = True,
                     axis: int = 0) -> np.ndarray:
         """Fetch this process's rows of a batch-sharded output.
@@ -595,16 +612,12 @@ class NetTrainer:
         do_up = np.asarray([((S + i + 1) % period) == 0
                             for i in range(K)])
         step0 = self._step_scalar()
-        data_k = self._stack_k(*[self._put_batch_array(b.data)
-                                 for b in batches])
-        labels_k = self._stack_k(*[self._put_batch_array(b.label)
-                                   for b in batches])
-        mask_k = self._stack_k(*[self._put_batch_array(self._mask(b))
-                                 for b in batches])
+        data_k = self._put_window([b.data for b in batches])
+        labels_k = self._put_window([b.label for b in batches])
+        mask_k = self._put_window([self._mask(b) for b in batches])
         n_extra = len(batches[0].extra_data)
         extra_k = tuple(
-            self._stack_k(*[self._put_batch_array(b.extra_data[j])
-                            for b in batches])
+            self._put_window([b.extra_data[j] for b in batches])
             for j in range(n_extra))
         collect = bool(self.eval_train and self._metrics.evals)
         out = self._many_step(self.params, self.opt_state,
