@@ -48,8 +48,8 @@ bin/bin2rec: tools/bin2rec.cc src/io/binpage.h src/io/recordio.cc \
 		src/io/recordio.h | bin
 	$(CXX) $(CXXFLAGS) -o $@ tools/bin2rec.cc src/io/recordio.cc
 
-# compile-only smoke for the Matlab mex wrapper: no Matlab in CI, so a
-# stub mex.h + linker shims stand in for $(MATLAB)/extern (catches
+# smoke for the Matlab mex wrapper: no Matlab in CI, so a functional
+# stub mex.h/mxArray stands in for $(MATLAB)/extern (catches
 # syntax/type/symbol errors; a real build just swaps the include path)
 mex-smoke: lib/cxxnet_mex_smoke.so
 lib/cxxnet_mex_smoke.so: wrapper/matlab/cxxnet_mex.cpp \
@@ -60,7 +60,22 @@ lib/cxxnet_mex_smoke.so: wrapper/matlab/cxxnet_mex.cpp \
 		wrapper/matlab/cxxnet_mex.cpp \
 		wrapper/matlab/mex_stub/mex_stub.cc
 
+# C host that EXECUTES the mex dispatch table against the functional
+# stub + the real embedded-CPython wrapper lib (the CI stand-in for
+# running example.m inside Matlab)
+mex-driver: bin/mex_driver
+bin/mex_driver: wrapper/matlab/mex_driver.cc \
+		wrapper/matlab/cxxnet_mex.cpp \
+		wrapper/matlab/mex_stub/mex.h \
+		wrapper/matlab/mex_stub/mex_stub.cc \
+		wrapper/cxxnet_wrapper.h $(WRAPLIB) | bin
+	$(CXX) $(CXXFLAGS) -Iwrapper/matlab/mex_stub -o $@ \
+		wrapper/matlab/mex_driver.cc \
+		wrapper/matlab/cxxnet_mex.cpp \
+		wrapper/matlab/mex_stub/mex_stub.cc \
+		-Llib -Wl,-rpath,$(abspath lib) -lcxxnet_wrapper
+
 clean:
 	rm -rf lib bin
 
-.PHONY: all clean mex-smoke
+.PHONY: all clean mex-smoke mex-driver

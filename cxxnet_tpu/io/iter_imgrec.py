@@ -25,8 +25,9 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .data import DataInst, IIterator
-from .recordio import (RAW_TENSOR_FLAG, RecordIOReader, record_flag,
-                       unpack_image_record, unpack_raw_tensor_record)
+from .recordio import (RAW_TENSOR_FLAG, RecordIOReader,
+                       parse_image_record, record_flag,
+                       unpack_raw_tensor_record)
 from ..utils.stream import open_stream
 
 
@@ -109,9 +110,14 @@ class ImageRecordIterator(IIterator):
                     if not toks:
                         continue
                     idx = int(float(toks[0]))
-                    self._label_map[idx] = np.asarray(
-                        [float(t) for t in toks[1:1 + self.label_width]],
-                        np.float32)
+                    # zero-pad short rows to label_width (same fill as
+                    # archive-packed label vectors in _with_label) so
+                    # mixed-coverage lists can't break batch stacking
+                    vals = [float(t)
+                            for t in toks[1:1 + self.label_width]]
+                    lab = np.zeros((self.label_width,), np.float32)
+                    lab[:len(vals)] = vals
+                    self._label_map[idx] = lab
         self._pool = ThreadPoolExecutor(max_workers=self.nthread)
         self._rng = np.random.RandomState(self.seed)
         if self.silent == 0:
@@ -136,7 +142,7 @@ class ImageRecordIterator(IIterator):
                 data = data.astype(np.float32)
             return self._with_label(index, label, data)
         import cv2
-        index, label, payload = unpack_image_record(rec)
+        index, label, labels, payload = parse_image_record(rec)
         img = cv2.imdecode(np.frombuffer(payload, np.uint8),
                            cv2.IMREAD_COLOR)
         if img is None:
@@ -144,13 +150,22 @@ class ImageRecordIterator(IIterator):
         data = img[:, :, ::-1]                        # BGR -> RGB
         if not self.decode_uint8:
             data = data.astype(np.float32)
-        return self._with_label(index, label, data)
+        return self._with_label(index, label, data, labels)
 
     def _with_label(self, index: int, label: float,
-                    data: np.ndarray) -> DataInst:
+                    data: np.ndarray,
+                    labels: Optional[np.ndarray] = None) -> DataInst:
+        # precedence mirrors the reference: an imglist remap overrides
+        # whatever the archive carries (image_recordio.h:21-24 "just
+        # supply a list file"), then archive-packed label vectors, then
+        # the header's single label broadcast to label_width
         lab = None
         if self._label_map is not None:
             lab = self._label_map.get(index)
+        if lab is None and labels is not None:
+            lab = np.zeros((self.label_width,), np.float32)
+            n = min(self.label_width, labels.size)
+            lab[:n] = labels[:n]
         if lab is None:
             lab = np.full((self.label_width,), label, np.float32)
         return DataInst(index=index, data=data, label=lab)

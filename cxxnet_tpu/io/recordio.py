@@ -249,14 +249,59 @@ def RecordIOReader(path: str, part_index: int = 0, num_parts: int = 1,
 _HDR = struct.Struct("<IfQQ")
 
 
-def pack_image_record(index: int, label: float, img_bytes: bytes,
+# multi-label records: the header's extension flag carries the label
+# width ('ML' tag in the high 16 bits, width in the low 16); labels
+# 2..N are packed as f32 right after the 24-byte header, before the
+# image payload. The reference reserves header.flag "for future
+# extension purposes" (src/io/image_recordio.h:17-20) but never packs
+# extra labels — its im2rec only validates label_width in the list
+# (tools/im2rec.cc:83-87); here the archive itself carries them so
+# multi-label flows need no list file at read time.
+MULTI_LABEL_TAG = 0x4D4C0000            # 'ML' << 16
+_ML_MASK = 0xFFFF0000
+
+
+def multi_label_width(flag: int) -> int:
+    """label count encoded in a record flag (0 if not a multi-label
+    record)."""
+    if (flag & _ML_MASK) == MULTI_LABEL_TAG:
+        return flag & 0xFFFF
+    return 0
+
+
+def pack_image_record(index: int, label, img_bytes: bytes,
                       flag: int = 0) -> bytes:
-    return _HDR.pack(flag, label, index, 0) + img_bytes
+    lab = np.atleast_1d(np.asarray(label, np.float32))
+    if not 1 <= lab.size <= 0xFFFF:
+        raise ValueError("label count out of range: %d" % lab.size)
+    if lab.size > 1:
+        assert flag == 0, "multi-label packs its own flag"
+        flag = MULTI_LABEL_TAG | lab.size
+        return (_HDR.pack(flag, float(lab[0]), index, 0)
+                + lab[1:].tobytes() + img_bytes)
+    return _HDR.pack(flag, float(lab[0]), index, 0) + img_bytes
+
+
+def parse_image_record(rec: bytes):
+    """-> (index, label0, label_vec | None, payload) in ONE header
+    parse (the hot decode path calls this per image)."""
+    flag, label, id0, _ = _HDR.unpack_from(rec, 0)
+    w = multi_label_width(flag)
+    if w == 0:
+        return int(id0), float(label), None, rec[_HDR.size:]
+    extra = np.frombuffer(rec, np.float32, w - 1, _HDR.size)
+    labels = np.concatenate([[np.float32(label)], extra])
+    return int(id0), float(label), labels, rec[_HDR.size + 4 * (w - 1):]
 
 
 def unpack_image_record(rec: bytes) -> Tuple[int, float, bytes]:
-    flag, label, id0, id1 = _HDR.unpack_from(rec, 0)
-    return int(id0), float(label), rec[_HDR.size:]
+    index, label, _, payload = parse_image_record(rec)
+    return index, label, payload
+
+
+def unpack_image_labels(rec: bytes) -> Optional[np.ndarray]:
+    """Full label vector of a multi-label record; None otherwise."""
+    return parse_image_record(rec)[2]
 
 
 def record_flag(rec: bytes) -> int:

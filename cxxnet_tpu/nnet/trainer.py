@@ -456,19 +456,23 @@ class NetTrainer:
             return self._local_rows(batch.label).astype(np.float32)
         return np.asarray(batch.label, np.float32)
 
+    def _ship(self, arr: np.ndarray, sharding) -> jnp.ndarray:
+        """Cast-and-transfer policy shared by per-batch and K-window
+        placement: u8 pixels ship raw (1/4 bytes, device casts), all
+        else float32; under multi-process dp each rank contributes its
+        local shard of the global batch (config batch_size is GLOBAL,
+        split across ranks like the reference splits across PS
+        workers)."""
+        if arr.dtype != np.uint8:
+            arr = np.asarray(arr, np.float32)
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sharding, arr)
+        return jax.device_put(arr, sharding)
+
     def _put_batch_array(self, x) -> jnp.ndarray:
         if isinstance(x, jax.Array) and x.sharding == self._b_shard:
             return x                      # already resident (test_skipread)
-        arr = np.asarray(x)
-        if arr.dtype != np.uint8:         # u8 pixels ship raw (1/4 bytes)
-            arr = np.asarray(arr, np.float32)
-        if jax.process_count() > 1:
-            # multi-process dp: each rank contributes its local shard of
-            # the global batch (config batch_size is GLOBAL, split across
-            # ranks like the reference splits across PS workers)
-            return jax.make_array_from_process_local_data(
-                self._b_shard, arr)
-        return jax.device_put(arr, self._b_shard)
+        return self._ship(np.asarray(x), self._b_shard)
 
     def _device_batch(self, batch: DataBatch):
         data = self._put_batch_array(batch.data)
@@ -500,13 +504,8 @@ class NetTrainer:
         if any(isinstance(a, jax.Array) for a in arrs):
             return self._stack_k(*[self._put_batch_array(a)
                                    for a in arrs])
-        stacked = np.stack([np.asarray(a) for a in arrs])
-        if stacked.dtype != np.uint8:     # u8 pixels ship raw
-            stacked = np.asarray(stacked, np.float32)
-        if jax.process_count() > 1:
-            return jax.make_array_from_process_local_data(
-                self._kb_shard, stacked)
-        return jax.device_put(stacked, self._kb_shard)
+        return self._ship(np.stack([np.asarray(a) for a in arrs]),
+                          self._kb_shard)
 
     def _local_rows(self, arr, flatten: bool = True,
                     axis: int = 0) -> np.ndarray:

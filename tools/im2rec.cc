@@ -3,13 +3,17 @@
  * \brief pack images into a RecordIO archive.
  *
  * Parity with /root/reference/tools/im2rec.cc:24-139: reads an image
- * list ("index label path" rows), optionally resizes the short edge and
- * re-encodes JPEG via OpenCV, writes image records (24-byte header +
- * jpeg bytes) into <out>.rec; nsplit/part shard the list for parallel
- * packing.
+ * list ("index label... path" rows), optionally resizes the short edge
+ * and re-encodes JPEG via OpenCV, writes image records (24-byte header
+ * + jpeg bytes) into <out>.rec; nsplit/part shard the list for
+ * parallel packing. label_width=N packs ALL N list labels into the
+ * record (header flag 'ML'|N + N-1 extra f32 after the header — the
+ * reference only validates the extra labels, tools/im2rec.cc:83-87;
+ * here the archive carries them, see cxxnet_tpu/io/recordio.py).
  *
  * Usage: im2rec <image.lst> <image_root> <output.rec>
  *               [resize=0] [quality=95] [nsplit=1] [part=0]
+ *               [label_width=1]
  */
 #include <cstdio>
 #include <cstdlib>
@@ -33,10 +37,11 @@ int main(int argc, char *argv[]) {
   if (argc < 4) {
     std::fprintf(stderr,
                  "Usage: im2rec image.lst image_root output.rec "
-                 "[resize=0] [quality=95] [nsplit=1] [part=0]\n");
+                 "[resize=0] [quality=95] [nsplit=1] [part=0] "
+                 "[label_width=1]\n");
     return 1;
   }
-  int resize = 0, quality = 95, nsplit = 1, part = 0;
+  int resize = 0, quality = 95, nsplit = 1, part = 0, label_width = 1;
   for (int i = 4; i < argc; ++i) {
     char key[64];
     int val;
@@ -45,7 +50,12 @@ int main(int argc, char *argv[]) {
       if (!std::strcmp(key, "quality")) quality = val;
       if (!std::strcmp(key, "nsplit")) nsplit = val;
       if (!std::strcmp(key, "part")) part = val;
+      if (!std::strcmp(key, "label_width")) label_width = val;
     }
+  }
+  if (label_width < 1 || label_width > 0xFFFF) {
+    std::fprintf(stderr, "label_width out of range: %d\n", label_width);
+    return 1;
   }
   std::ifstream lst(argv[1]);
   if (!lst.good()) {
@@ -67,7 +77,7 @@ int main(int argc, char *argv[]) {
   }
 
   std::string line;
-  size_t count = 0, lineno = 0;
+  size_t count = 0, lineno = 0, myrows = 0;
   std::string blob;
   std::vector<uint8_t> encoded;
   while (std::getline(lst, line)) {
@@ -76,16 +86,58 @@ int main(int argc, char *argv[]) {
         static_cast<int>(myline % static_cast<size_t>(nsplit)) != part) {
       continue;
     }
+    ++myrows;
     std::istringstream is(line);
     double index, label;
     std::string path;
-    if (!(is >> index >> label >> path)) continue;
+    if (line.find_first_not_of(" \t\r\n") == std::string::npos) {
+      continue;                           /* blank line */
+    }
+    if (!(is >> index >> label)) {
+      std::fprintf(stderr, "unparseable list row: %s\n", line.c_str());
+      return 1;
+    }
+    std::vector<float> extra_labels;
+    for (int k = 1; k < label_width; ++k) {
+      double tmp;
+      if (!(is >> tmp)) {
+        std::fprintf(stderr,
+                     "invalid list row (label_width=%d?): %s\n",
+                     label_width, line.c_str());
+        return 1;
+      }
+      extra_labels.push_back(static_cast<float>(tmp));
+    }
+    if (!(is >> path)) {
+      std::fprintf(stderr, "list row missing image path: %s\n",
+                   line.c_str());
+      return 1;
+    }
+    // a purely numeric "path" with tokens still left on the row means
+    // the list has MORE labels than label_width — a silent misparse
+    // (each row would be skipped as unreadable and the tool would
+    // exit 0 with an empty archive). Guarded by a trailing-token check
+    // so legitimately numeric basenames in a plain list still pack.
+    char *endp = nullptr;
+    std::strtod(path.c_str(), &endp);
+    std::string trailing;
+    if (endp != nullptr && *endp == '\0' && (is >> trailing)) {
+      std::fprintf(stderr,
+                   "numeric path token %s followed by %s — does the "
+                   "list have more labels than label_width=%d?\n",
+                   path.c_str(), trailing.c_str(), label_width);
+      return 1;
+    }
     std::string full = root + path;
 
     ImageRecHeader hdr;
     std::memset(&hdr, 0, sizeof(hdr));
     hdr.label = static_cast<float>(label);
     hdr.image_id[0] = static_cast<uint64_t>(index);
+    if (label_width > 1) {
+      hdr.flag = 0x4D4C0000u |                  /* 'ML' tag */
+                 static_cast<uint32_t>(label_width);
+    }
 
     const uint8_t *payload = nullptr;
     size_t payload_size = 0;
@@ -130,9 +182,13 @@ int main(int argc, char *argv[]) {
       payload = encoded.data();
       payload_size = encoded.size();
     }
-    blob.resize(sizeof(hdr) + payload_size);
+    size_t extra_bytes = extra_labels.size() * sizeof(float);
+    blob.resize(sizeof(hdr) + extra_bytes + payload_size);
     std::memcpy(&blob[0], &hdr, sizeof(hdr));
-    std::memcpy(&blob[sizeof(hdr)], payload, payload_size);
+    if (extra_bytes > 0) {
+      std::memcpy(&blob[sizeof(hdr)], extra_labels.data(), extra_bytes);
+    }
+    std::memcpy(&blob[sizeof(hdr) + extra_bytes], payload, payload_size);
     writer.WriteRecord(blob.data(), blob.size());
     if (++count % 1000 == 0) {
       std::printf("%zu images packed\n", count);
@@ -142,6 +198,11 @@ int main(int argc, char *argv[]) {
   if (writer.HasError()) {
     std::fprintf(stderr, "im2rec: write failed (disk full?): %s\n",
                  outpath.c_str());
+    return 1;
+  }
+  if (count == 0 && myrows > 0) {
+    std::fprintf(stderr, "im2rec: no images packed from %zu list rows\n",
+                 myrows);
     return 1;
   }
   std::printf("im2rec: packed %zu images into %s\n", count,

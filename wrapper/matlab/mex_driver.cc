@@ -1,0 +1,175 @@
+/*
+ * C host that EXECUTES the mex dispatch table (cxxnet_mex.cpp) against
+ * the functional mex stub — the CI equivalent of running the
+ * reference's wrapper/matlab/example.m in Matlab: iterator create /
+ * next / getdata / getlabel, net create / setparam / init / train
+ * (both update-from-iter and update-from-batch), evaluate, predict
+ * (batch + iter), weight get/set round-trip, feature extraction, and
+ * model save / load.
+ *
+ * usage: mex_driver <train.csv> <model_save_path>
+ * The csv is written by the pytest harness with row i, feature j equal
+ * to (i*10+j)/320 so the column-major <-> row-major transposition in
+ * the mex layer is verified against known values.
+ */
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <string>
+#include <vector>
+
+#include "mex_stub/mex.h"
+
+#define CHECK(cond)                                                  \
+  do {                                                               \
+    if (!(cond)) {                                                   \
+      std::fprintf(stderr, "mex_driver FAIL %s:%d: %s\n", __FILE__,  \
+                   __LINE__, #cond);                                 \
+      std::exit(1);                                                  \
+    }                                                                \
+  } while (0)
+
+static mxArray *Call(const char *cmd,
+                     const std::vector<const mxArray *> &args,
+                     int nlhs = 1) {
+  std::vector<const mxArray *> in;
+  in.push_back(mxCreateString(cmd));
+  for (const mxArray *a : args) in.push_back(a);
+  mxArray *out[4] = {NULL, NULL, NULL, NULL};
+  mexFunction(nlhs, out, (int)in.size(),
+              const_cast<const mxArray **>(in.data()));
+  return out[0];
+}
+
+/* column-major single array with Matlab dims (d0,d1,d2,d3) */
+static mxArray *Single4(mwSize d0, mwSize d1, mwSize d2, mwSize d3) {
+  mwSize dims[4] = {d0, d1, d2, d3};
+  return mxCreateNumericArray(4, dims, mxSINGLE_CLASS, mxREAL);
+}
+
+static float *F(mxArray *a) {
+  return static_cast<float *>(mxGetData(a));
+}
+
+int main(int argc, char **argv) {
+  CHECK(argc == 3);
+  const std::string csv = argv[1], model_path = argv[2];
+
+  const std::string iter_cfg =
+      "iter = csv\n  filename = " + csv +
+      "\n  input_shape = 1,1,10\n  label_width = 1\n"
+      "iter = end\nbatch_size = 8\n";
+  const char *net_cfg =
+      "netconfig = start\n"
+      "layer[0->1] = fullc:fc1\n  nhidden = 16\n"
+      "layer[1->2] = relu\n"
+      "layer[2->3] = fullc:fc2\n  nhidden = 4\n"
+      "layer[3->3] = softmax\n"
+      "netconfig = end\n"
+      "input_shape = 1,1,10\nbatch_size = 8\n"
+      "eta = 0.2\nmetric = error\n";
+
+  /* ---- iterator: create / next / getdata / getlabel ---- */
+  mxArray *it = Call("MEXCXNIOCreateFromConfig",
+                     {mxCreateString(iter_cfg.c_str())});
+  CHECK(it != NULL);
+  int nbatch = 0;
+  while (mxGetScalar(Call("MEXCXNIONext", {it})) != 0.0) ++nbatch;
+  CHECK(nbatch == 4);                        /* 32 rows / batch 8 */
+  Call("MEXCXNIOBeforeFirst", {it}, 0);
+  CHECK(mxGetScalar(Call("MEXCXNIONext", {it})) != 0.0);
+
+  mxArray *d = Call("MEXCXNIOGetData", {it});
+  const mwSize *dd = mxGetDimensions(d);
+  CHECK(mxGetNumberOfDimensions(d) >= 2);
+  CHECK(dd[0] == 8 && dd[1] == 1 && dd[2] == 1 && dd[3] == 10);
+  /* col-major (n,c,h,w): element (n=i, w=j) sits at i + 8*j */
+  for (int i = 0; i < 8; ++i)
+    for (int j = 0; j < 10; ++j)
+      CHECK(std::fabs(F(d)[i + 8 * j] - (i * 10 + j) / 320.0f) < 1e-5f);
+
+  mxArray *lab = Call("MEXCXNIOGetLabel", {it});
+  const mwSize *ld = mxGetDimensions(lab);
+  CHECK(ld[0] == 8 && ld[1] == 1);
+  CHECK(F(lab)[0] == 0.0f && F(lab)[3] == 3.0f);  /* label = row %% 4 */
+
+  /* ---- net: create / setparam / init / train ---- */
+  mxArray *net = Call("MEXCXNNetCreate",
+                      {mxCreateString("tpu"), mxCreateString(net_cfg)});
+  CHECK(net != NULL);
+  Call("MEXCXNNetSetParam",
+       {net, mxCreateString("eta"), mxCreateString("0.2")}, 0);
+  Call("MEXCXNNetInitModel", {net}, 0);
+
+  for (int r = 0; r < 3; ++r) {
+    Call("MEXCXNNetStartRound", {net, mxCreateDoubleScalar(r)}, 0);
+    Call("MEXCXNIOBeforeFirst", {it}, 0);
+    while (mxGetScalar(Call("MEXCXNIONext", {it})) != 0.0)
+      Call("MEXCXNNetUpdateIter", {net, it}, 0);
+  }
+  /* one update from an explicit (data,label) pair — exercises the
+     col-major -> NCHW transposition on the way IN */
+  Call("MEXCXNIOBeforeFirst", {it}, 0);
+  CHECK(mxGetScalar(Call("MEXCXNIONext", {it})) != 0.0);
+  mxArray *bd = Call("MEXCXNIOGetData", {it});
+  mxArray *bl = Call("MEXCXNIOGetLabel", {it});
+  Call("MEXCXNNetUpdateBatch", {net, bd, bl}, 0);
+
+  /* ---- evaluate ---- */
+  mxArray *ev = Call("MEXCXNNetEvaluate",
+                     {net, it, mxCreateString("train")});
+  char *evs = mxArrayToString(ev);
+  CHECK(evs != NULL && std::strstr(evs, "train-error:") != NULL);
+  std::printf("evaluate: %s\n", evs);
+
+  /* ---- predict: batch + iter ---- */
+  mxArray *p1 = Call("MEXCXNNetPredictBatch", {net, bd});
+  CHECK(mxGetDimensions(p1)[0] == 8);
+  for (int i = 0; i < 8; ++i)
+    CHECK(F(p1)[i] >= 0.0f && F(p1)[i] <= 3.0f);
+  Call("MEXCXNIOBeforeFirst", {it}, 0);
+  CHECK(mxGetScalar(Call("MEXCXNIONext", {it})) != 0.0);
+  mxArray *p2 = Call("MEXCXNNetPredictIter", {net, it});
+  CHECK(mxGetDimensions(p2)[0] == 8);
+  for (int i = 0; i < 8; ++i) CHECK(F(p1)[i] == F(p2)[i]);
+
+  /* ---- weight get / set round-trip ---- */
+  mxArray *w = Call("MEXCXNNetGetWeight",
+                    {net, mxCreateString("fc1"), mxCreateString("wmat")});
+  const mwSize *wd = mxGetDimensions(w);
+  CHECK(wd[0] == 16 && wd[1] == 10);
+  mxArray *w2 = Single4(16, 10, 1, 1);
+  for (int i = 0; i < 160; ++i) F(w2)[i] = 0.5f;
+  Call("MEXCXNNetSetWeight",
+       {net, w2, mxCreateString("fc1"), mxCreateString("wmat")}, 0);
+  mxArray *w3 = Call("MEXCXNNetGetWeight",
+                     {net, mxCreateString("fc1"), mxCreateString("wmat")});
+  for (int i = 0; i < 160; ++i) CHECK(F(w3)[i] == 0.5f);
+  /* restore the trained weights (col-major w is what SetWeight takes) */
+  Call("MEXCXNNetSetWeight",
+       {net, w, mxCreateString("fc1"), mxCreateString("wmat")}, 0);
+
+  /* ---- feature extraction ---- */
+  mxArray *e = Call("MEXCXNNetExtractBatch",
+                    {net, bd, mxCreateString("top[-1]")});
+  const mwSize *ed = mxGetDimensions(e);
+  CHECK(ed[0] == 8 && ed[1] == 1 && ed[2] == 1 && ed[3] == 16);
+
+  /* ---- save / load: predictions must survive the round-trip ---- */
+  Call("MEXCXNNetSaveModel", {net, mxCreateString(model_path.c_str())},
+       0);
+  mxArray *net2 = Call("MEXCXNNetCreate",
+                       {mxCreateString("tpu"), mxCreateString(net_cfg)});
+  Call("MEXCXNNetLoadModel",
+       {net2, mxCreateString(model_path.c_str())}, 0);
+  mxArray *p3 = Call("MEXCXNNetPredictBatch", {net2, bd});
+  for (int i = 0; i < 8; ++i) CHECK(F(p3)[i] == F(p1)[i]);
+
+  Call("MEXCXNNetFree", {net2}, 0);
+  Call("MEXCXNNetFree", {net}, 0);
+  Call("MEXCXNIOFree", {it}, 0);
+  std::printf("MEX-DRIVER-OK nbatch=%d first_pred=%d\n", nbatch,
+              (int)F(p1)[0]);
+  return 0;
+}

@@ -1,13 +1,13 @@
 /*
- * Minimal mex.h stub for COMPILE-ONLY smoke testing of cxxnet_mex.cpp.
+ * Minimal mex.h stub for testing cxxnet_mex.cpp without Matlab.
  *
  * No Matlab is available in CI, so this header supplies just enough of
- * the mx/mex API surface (types, class IDs, prototypes) to typecheck
- * and compile the mex source the way a real
- * $MATLAB/extern/include/mex.h would. The shim implementations in
- * mex_stub.cc exist only to satisfy the linker for an object-level
- * build; nothing here is ever executed. Mirrors the subset the
- * reference's 440-line mex file relies on
+ * the mx/mex API surface (types, class IDs, prototypes) to compile the
+ * mex source the way a real $MATLAB/extern/include/mex.h would. The
+ * implementations in mex_stub.cc are a functional miniature mxArray
+ * (column-major data + class id + dims), so mex_driver.cc can EXECUTE
+ * the mexFunction dispatch table in CI, not just link it. Mirrors the
+ * subset the reference's 440-line mex file relies on
  * (/root/reference/wrapper/matlab/cxxnet_mex.cpp).
  */
 #ifndef CXXNET_MEX_STUB_H_

@@ -1,8 +1,13 @@
 /*
- * Linker shims for the compile-only mex smoke test (see mex.h here).
- * Never executed — they exist so cxxnet_mex.cpp can link into a shared
- * object in CI without Matlab, catching missing-symbol typos as well as
- * type errors.
+ * Functional mx/mex shims for driving cxxnet_mex.cpp WITHOUT Matlab.
+ *
+ * Round 3 these were link-only stubs (compile smoke); round 4 they are
+ * a real miniature mxArray implementation — dense column-major arrays
+ * with class ids and dimensions — so a C host program (mex_driver.cc)
+ * can call mexFunction() and execute the full dispatch table the way
+ * Matlab would run the reference's example.m
+ * (/root/reference/wrapper/matlab/example.m). Only the subset of the
+ * mx API that cxxnet_mex.cpp and the driver use is implemented.
  */
 #include "mex.h"
 
@@ -12,29 +17,98 @@
 
 extern "C" {
 
-struct mxArray_tag { int unused; };
+struct mxArray_tag {
+  mxClassID classid;
+  mwSize ndim;
+  mwSize dims[8];
+  void *data;      /* column-major payload, malloc'd */
+  mwSize nelem;
+};
 
-static mxArray dummy_array;
-
-mxArray *mxCreateNumericArray(mwSize, const mwSize *, mxClassID,
-                              mxComplexity) { return &dummy_array; }
-mxArray *mxCreateNumericMatrix(mwSize, mwSize, mxClassID,
-                               mxComplexity) { return &dummy_array; }
-mxArray *mxCreateDoubleScalar(double) { return &dummy_array; }
-mxArray *mxCreateString(const char *) { return &dummy_array; }
-char *mxArrayToString(const mxArray *) {
-  return static_cast<char *>(std::malloc(1));
+static mwSize ElemSize(mxClassID c) {
+  switch (c) {
+    case mxDOUBLE_CLASS: case mxINT64_CLASS: case mxUINT64_CLASS:
+      return 8;
+    case mxSINGLE_CLASS: case mxINT32_CLASS: case mxUINT32_CLASS:
+      return 4;
+    case mxINT16_CLASS: case mxUINT16_CLASS:
+      return 2;
+    default:
+      return 1;
+  }
 }
+
+static mxArray *Alloc(mwSize ndim, const mwSize *dims, mxClassID c) {
+  mxArray *a = static_cast<mxArray *>(std::calloc(1, sizeof(mxArray)));
+  a->classid = c;
+  a->ndim = ndim < 2 ? 2 : ndim;
+  a->nelem = 1;
+  for (mwSize i = 0; i < 8; ++i) a->dims[i] = 1;
+  for (mwSize i = 0; i < ndim && i < 8; ++i) {
+    a->dims[i] = dims[i];
+    a->nelem *= dims[i];
+  }
+  a->data = std::calloc(a->nelem ? a->nelem : 1, ElemSize(c));
+  return a;
+}
+
+mxArray *mxCreateNumericArray(mwSize ndim, const mwSize *dims,
+                              mxClassID classid, mxComplexity) {
+  return Alloc(ndim, dims, classid);
+}
+
+mxArray *mxCreateNumericMatrix(mwSize m, mwSize n, mxClassID classid,
+                               mxComplexity) {
+  mwSize dims[2] = {m, n};
+  return Alloc(2, dims, classid);
+}
+
+mxArray *mxCreateDoubleScalar(double value) {
+  mwSize dims[2] = {1, 1};
+  mxArray *a = Alloc(2, dims, mxDOUBLE_CLASS);
+  *static_cast<double *>(a->data) = value;
+  return a;
+}
+
+mxArray *mxCreateString(const char *str) {
+  mwSize n = std::strlen(str);
+  mwSize dims[2] = {1, n};
+  mxArray *a = Alloc(2, dims, mxCHAR_CLASS);
+  std::memcpy(a->data, str, n);
+  return a;
+}
+
+char *mxArrayToString(const mxArray *a) {
+  if (a == NULL || a->classid != mxCHAR_CLASS) return NULL;
+  char *s = static_cast<char *>(std::malloc(a->nelem + 1));
+  std::memcpy(s, a->data, a->nelem);
+  s[a->nelem] = '\0';
+  return s;
+}
+
 void mxFree(void *ptr) { std::free(ptr); }
-void *mxGetData(const mxArray *) { return nullptr; }
-double mxGetScalar(const mxArray *) { return 0.0; }
-mwSize mxGetNumberOfDimensions(const mxArray *) { return 0; }
-const mwSize *mxGetDimensions(const mxArray *) { return nullptr; }
-bool mxIsSingle(const mxArray *) { return true; }
+
+void *mxGetData(const mxArray *a) { return a->data; }
+
+double mxGetScalar(const mxArray *a) {
+  switch (a->classid) {
+    case mxDOUBLE_CLASS: return *static_cast<const double *>(a->data);
+    case mxSINGLE_CLASS: return *static_cast<const float *>(a->data);
+    case mxUINT64_CLASS:
+      return (double)*static_cast<const uint64_t *>(a->data);
+    default: return 0.0;
+  }
+}
+
+mwSize mxGetNumberOfDimensions(const mxArray *a) { return a->ndim; }
+const mwSize *mxGetDimensions(const mxArray *a) { return a->dims; }
+bool mxIsSingle(const mxArray *a) {
+  return a->classid == mxSINGLE_CLASS;
+}
 
 void mexErrMsgTxt(const char *msg) {
   std::fprintf(stderr, "mex error: %s\n", msg ? msg : "");
-  std::abort();
+  std::exit(1);
 }
 
 }  /* extern "C" */
