@@ -34,7 +34,8 @@ MODELS = {
 
 def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
             dtype: str = "bfloat16",
-            grad_dtype: str = "float32") -> float:
+            grad_dtype: str = "float32",
+            extra: tuple = (), builder_kw: dict = None) -> float:
     import jax
     import cxxnet_tpu.models as zoo
     from cxxnet_tpu.io.data import DataBatch
@@ -46,9 +47,11 @@ def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
         batch = default_batch
     builder = getattr(zoo, model)
     t = NetTrainer(parse_config(builder(nclass=1000, batch_size=batch,
-                                        image_size=size))
+                                        image_size=size,
+                                        **(builder_kw or {})))
                    + [("eval_train", "0"), ("dtype", dtype),
-                      ("grad_dtype", grad_dtype), ("silent", "1")])
+                      ("grad_dtype", grad_dtype), ("silent", "1")]
+                   + list(extra))
     t.init_model()
 
     rng = np.random.RandomState(0)
@@ -197,7 +200,8 @@ def main():
                     help="measure one model (default: all, with the "
                          "AlexNet headline)")
     ap.add_argument("--steps", type=int, default=None,
-                    help="scanned steps (default: 200 alexnet, 50 others)")
+                    help="scanned steps (default 200; 50-step runs "
+                         "read 2-4%% low — doc/perf_profile.md r4)")
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--grad-dtype", choices=["float32", "bfloat16"],
                     default="float32",
@@ -219,8 +223,7 @@ def main():
         return
     if args.model is not None:
         model = args.model
-        steps = args.steps if args.steps is not None else (
-            200 if model == "alexnet" else 50)
+        steps = args.steps if args.steps is not None else 200
         ips = measure(steps=steps, batch=args.batch, model=model,
                       grad_dtype=args.grad_dtype)
         # 'AlexNet' spelling keeps the canonical BENCH metric name
@@ -242,8 +245,7 @@ def main():
     import gc
     models = {}
     for m in sorted(MODELS):
-        steps = args.steps if args.steps is not None else (
-            200 if m == "alexnet" else 50)
+        steps = args.steps if args.steps is not None else 200
         models[m] = round(measure(steps=steps, model=m,
                                   grad_dtype=args.grad_dtype), 1)
         gc.collect()                     # free HBM before the next model

@@ -110,11 +110,17 @@ class ImageRecordIterator(IIterator):
                     if not toks:
                         continue
                     idx = int(float(toks[0]))
-                    # zero-pad short rows to label_width (same fill as
-                    # archive-packed label vectors in _with_label) so
-                    # mixed-coverage lists can't break batch stacking
-                    vals = [float(t)
-                            for t in toks[1:1 + self.label_width]]
+                    # labels are the numeric prefix (rows end with the
+                    # image path); zero-pad short rows to label_width
+                    # (same fill as archive-packed label vectors in
+                    # _with_label) so mixed-width lists can't break
+                    # batch stacking or crash on the path token
+                    vals = []
+                    for t in toks[1:1 + self.label_width]:
+                        try:
+                            vals.append(float(t))
+                        except ValueError:
+                            break
                     lab = np.zeros((self.label_width,), np.float32)
                     lab[:len(vals)] = vals
                     self._label_map[idx] = lab

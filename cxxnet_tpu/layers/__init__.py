@@ -47,6 +47,8 @@ _FACTORY: Dict[str, Callable[..., Layer]] = {
     "avg_pooling": lambda cfg, **kw: PoolingLayer("avg", cfg),
     "relu_max_pooling": lambda cfg, **kw: PoolingLayer("max", cfg,
                                                        pre_relu=True),
+    "pallas_relu_max_pooling": lambda cfg, **kw: PoolingLayer(
+        "max", cfg, pre_relu=True, use_pallas=True),
     "lrn": lambda cfg, **kw: LRNLayer(cfg),
     "concat": lambda cfg, **kw: ConcatLayer(3, cfg),
     "ch_concat": lambda cfg, **kw: ConcatLayer(1, cfg),

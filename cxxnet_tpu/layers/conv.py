@@ -151,7 +151,15 @@ class ConvolutionLayer(Layer):
             # in f32 internally)
             x = x.astype(jnp.bfloat16)
             w = w.astype(jnp.bfloat16)
-        if (p.stride > 1 and p.num_group == 1 and x.shape[-1] <= 8
+        if (p.conv_1x1_matmul and p.kernel_height == 1
+                and p.kernel_width == 1 and p.stride == 1
+                and p.num_group == 1 and p.pad_y == 0 and p.pad_x == 0):
+            # pointwise conv as an explicit (B*H*W, Cin) @ (Cin, Cout)
+            # matmul — experiment toggle, see doc/perf_profile.md
+            b, h, wd, c = x.shape
+            y = jnp.dot(x.reshape(b * h * wd, c), w.reshape(c, -1))
+            y = y.reshape(b, h, wd, -1)
+        elif (p.stride > 1 and p.num_group == 1 and x.shape[-1] <= 8
                 and p.kernel_height == p.kernel_width):
             # padded entry convs (Inception stem 7x7 s2 p3) zero-pad
             # explicitly, then the same VALID space-to-depth rewrite
@@ -183,9 +191,11 @@ class PoolingLayer(Layer):
     (the reference's relu_max_pooling, layer_impl-inl.hpp:55-56).
     """
 
-    def __init__(self, mode: str, cfg=(), pre_relu: bool = False):
+    def __init__(self, mode: str, cfg=(), pre_relu: bool = False,
+                 use_pallas: bool = False):
         self.mode = mode
         self.pre_relu = pre_relu
+        self.use_pallas = use_pallas
         super().__init__(cfg)
 
     def infer_shape(self, in_shapes: List[Shape3]) -> List[Shape3]:
@@ -234,6 +244,12 @@ class PoolingLayer(Layer):
     def forward(self, params, state, inputs, is_train, rng):
         x = inputs[0]
         if self.pre_relu:
+            p = self.param
+            if ((self.use_pallas or p.pallas_pool) and self.mode == "max"):
+                from .pallas_kernels import (relu_max_pool,
+                                             relu_max_pool_applicable)
+                if relu_max_pool_applicable(x.shape, p):
+                    return [relu_max_pool(x, p.kernel_height)], state
             x = jax.nn.relu(x)
         return [self._pool(x)], state
 
@@ -435,8 +451,17 @@ class BatchNormLayer(Layer):
         slope, bias = params["wmat"], params["bias"]
         if is_train:
             mean, var = self._moments(x, mask)
-            xhat = (x - mean) * jax.lax.rsqrt(var + self.eps)
-            out = (xhat * slope + bias).astype(x.dtype)
+            if self.param.bn_fold_affine:
+                # fold normalize+affine into per-channel scale/shift
+                # (computed in f32, applied in the compute dtype): the
+                # full-tensor path is one fused multiply-add instead of
+                # an f32-upcast sub/mul/mul/add chain
+                scale = slope * jax.lax.rsqrt(var + self.eps)
+                shift = bias - mean * scale
+                out = x * scale.astype(x.dtype) + shift.astype(x.dtype)
+            else:
+                xhat = (x - mean) * jax.lax.rsqrt(var + self.eps)
+                out = (xhat * slope + bias).astype(x.dtype)
             if self.moving_avg:
                 m = self.bn_momentum
                 state = dict(

@@ -58,7 +58,9 @@ class LossLayer(Layer):
 
     def loss_value(self, logit: jnp.ndarray, label: jnp.ndarray,
                    mask: jnp.ndarray) -> jnp.ndarray:
-        """Scalar loss; mask is 1.0 for real rows, 0.0 for tail padding."""
+        """Scalar loss; mask is 1.0 for real rows, 0.0 for tail
+        padding — or None when every row is real (the steady-state
+        specialization skips the mask multiply)."""
         raise NotImplementedError
 
 
@@ -77,7 +79,9 @@ class SoftmaxLayer(LossLayer):
         lab = label[:, 0].astype(jnp.int32)
         logp = jax.nn.log_softmax(logit.astype(jnp.float32), axis=-1)
         ce = -jnp.take_along_axis(logp, lab[:, None], axis=-1)[:, 0]
-        return self._scale() * jnp.sum(ce * mask)
+        if mask is not None:
+            ce = ce * mask
+        return self._scale() * jnp.sum(ce)
 
 
 class LpLossLayer(LossLayer):
@@ -107,7 +111,10 @@ class LpLossLayer(LossLayer):
             lp = d
         else:
             lp = jnp.power(d, self.p)
-        return self._scale() * jnp.sum(jnp.sum(lp, axis=-1) * mask)
+        row = jnp.sum(lp, axis=-1)
+        if mask is not None:
+            row = row * mask
+        return self._scale() * jnp.sum(row)
 
 
 class MultiLogisticLayer(LossLayer):
@@ -125,4 +132,7 @@ class MultiLogisticLayer(LossLayer):
         # numerically stable BCE-with-logits
         bce = jnp.maximum(logit, 0) - logit * label \
             + jnp.log1p(jnp.exp(-jnp.abs(logit)))
-        return self._scale() * jnp.sum(jnp.sum(bce, axis=-1) * mask)
+        row = jnp.sum(bce, axis=-1)
+        if mask is not None:
+            row = row * mask
+        return self._scale() * jnp.sum(row)

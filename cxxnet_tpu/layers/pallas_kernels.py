@@ -86,6 +86,162 @@ def _matmul_bwd(res, dy):
 matmul.defvjp(_matmul_fwd, _matmul_bwd)
 
 
+# ---------------------------------------------------- fused relu+maxpool
+
+def _relu_pool_fwd_kernel(k: int, x_ref, y_ref):
+    """One batch item: y = max-pool(relu(x)) over a k*k stride-1 VALID
+    window — relu applied in-register, no materialized relu tensor."""
+    x = x_ref[0]
+    r = jnp.maximum(x, 0)
+    oh = x.shape[0] - k + 1
+    ow = x.shape[1] - k + 1
+    y = r[0:oh, 0:ow, :]
+    for di in range(k):
+        for dj in range(k):
+            if di == 0 and dj == 0:
+                continue
+            y = jnp.maximum(y, r[di:di + oh, dj:dj + ow, :])
+    y_ref[0] = y
+
+
+def _relu_pool_bwd_kernel(k: int, x_ref, y_ref, dy_ref, dx_ref, acc_ref):
+    """dx in one pass: every input equal to its window max receives the
+    window's cotangent (the reference's exact unpool tie semantics,
+    mshadow unpool — XLA's select-and-scatter credits only the first
+    max), then the relu mask. f32 accumulation in VMEM scratch."""
+    x = x_ref[0]
+    # compares run in f32 (bf16 vector compare is unsupported on some
+    # Mosaic targets); bf16->f32 is exact so tie semantics are unchanged
+    r = jnp.maximum(x, 0).astype(jnp.float32)
+    y = y_ref[0].astype(jnp.float32)
+    dy = dy_ref[0].astype(jnp.float32)
+    oh, ow = y.shape[0], y.shape[1]
+    acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+    for di in range(k):
+        for dj in range(k):
+            contrib = jnp.where(r[di:di + oh, dj:dj + ow, :] == y,
+                                dy, 0.0)
+            acc_ref[di:di + oh, dj:dj + ow, :] = (
+                acc_ref[di:di + oh, dj:dj + ow, :] + contrib)
+    dx_ref[0] = jnp.where(x.astype(jnp.float32) > 0, acc_ref[...],
+                          0.0).astype(x.dtype)
+
+
+def _chunk_rows(h: int, w: int, c: int, k: int, itemsize: int) -> int:
+    """Output rows per pallas call so the scoped-VMEM working set stays
+    well under the 16MB limit. Mosaic pads the (W, C) tile dims (W to
+    the sublane multiple, C to 128 lanes); the unrolled k*k slice maxes
+    plus in/out double-buffering keep roughly a dozen row-sized buffers
+    live (the un-chunked 109x109x64 bf16 stem measured 29.3MB scoped)."""
+    padded_row = _pad_to(w, 32 // itemsize) * _pad_to(c, 128) * itemsize
+    rows = (5 * 1024 * 1024) // (padded_row * 12)
+    return max(8, min(h - k + 1, rows))
+
+
+def _relu_pool_call_fwd(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+
+    b, h, w, c = x.shape
+    oh, ow = h - k + 1, w - k + 1
+    return pl.pallas_call(
+        partial(_relu_pool_fwd_kernel, k),
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0))],
+        out_specs=pl.BlockSpec((1, oh, ow, c), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, oh, ow, c), x.dtype),
+        interpret=_interpret(),
+    )(x)
+
+
+def _relu_pool_pallas_fwd(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    b, h, w, c = x.shape
+    oh = h - k + 1
+    rows = _chunk_rows(h, w, c, k, x.dtype.itemsize)
+    if rows >= oh:
+        return _relu_pool_call_fwd(x, k)
+    ys = []
+    for o in range(0, oh, rows):
+        r = min(rows, oh - o)
+        xi = jax.lax.slice_in_dim(x, o, o + r + k - 1, axis=1)
+        ys.append(_relu_pool_call_fwd(xi, k))
+    return jnp.concatenate(ys, axis=1)
+
+
+def _relu_pool_call_bwd(x: jnp.ndarray, y: jnp.ndarray,
+                        dy: jnp.ndarray, k: int) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, w, c = x.shape
+    oh, ow = y.shape[1], y.shape[2]
+    return pl.pallas_call(
+        partial(_relu_pool_bwd_kernel, k),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, oh, ow, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, oh, ow, c), lambda i: (i, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, c), x.dtype),
+        scratch_shapes=[pltpu.VMEM((h, w, c), jnp.float32)],
+        interpret=_interpret(),
+    )(x, y, dy)
+
+
+def _relu_pool_pallas_bwd(x: jnp.ndarray, y: jnp.ndarray,
+                          dy: jnp.ndarray, k: int) -> jnp.ndarray:
+    b, h, w, c = x.shape
+    oh = y.shape[1]
+    rows = _chunk_rows(h, w, c, k, x.dtype.itemsize)
+    if rows >= oh:
+        return _relu_pool_call_bwd(x, y, dy, k)
+    # chunk along H with a k-1 halo; dx chunks overlap by the halo, so
+    # accumulate into the full-size cotangent
+    dx = jnp.zeros_like(x)
+    for o in range(0, oh, rows):
+        r = min(rows, oh - o)
+        xi = jax.lax.slice_in_dim(x, o, o + r + k - 1, axis=1)
+        yi = jax.lax.slice_in_dim(y, o, o + r, axis=1)
+        dyi = jax.lax.slice_in_dim(dy, o, o + r, axis=1)
+        dxi = _relu_pool_call_bwd(xi, yi, dyi, k)
+        dx = dx.at[:, o:o + r + k - 1].add(dxi)
+    return dx
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def relu_max_pool(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Fused relu + k*k stride-1 VALID max pool (NHWC) as one Pallas
+    kernel per direction — the hand-kernel answer to kaiming's stem
+    pool, whose select-and-scatter backward profiled at 28% of the
+    step (doc/perf_profile.md). The CUDA precedent is the reference's
+    hand-written pooling Plan (insanity_pooling_layer-inl.hpp:12-220).
+    """
+    return _relu_pool_pallas_fwd(x, k)
+
+
+def _relu_pool_vjp_fwd(x, k):
+    y = _relu_pool_pallas_fwd(x, k)
+    return y, (x, y)
+
+
+def _relu_pool_vjp_bwd(k, res, dy):
+    x, y = res
+    return (_relu_pool_pallas_bwd(x, y, dy, k),)
+
+
+relu_max_pool.defvjp(_relu_pool_vjp_fwd, _relu_pool_vjp_bwd)
+
+
+def relu_max_pool_applicable(shape, param) -> bool:
+    """Config gate for the fused kernel: stride-1 VALID square max
+    pools with a real window (H is chunked internally, so any extent
+    fits VMEM; a single ROW must — true for every conv feature map)."""
+    return (param.stride == 1 and param.pad_y == 0 and param.pad_x == 0
+            and param.kernel_height == param.kernel_width
+            and param.kernel_height > 1)
+
+
 class PallasFullConnectLayer(FullConnectLayer):
     """fullc with the matmul lowered through the Pallas kernel
     (config name ``pallas_fullc``); numerically identical to ``fullc``

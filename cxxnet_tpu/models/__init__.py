@@ -10,9 +10,9 @@ prefer Python over config files.
 
 from .mnist import mnist_mlp, mnist_conv
 from .alexnet import alexnet
-from .inception import inception_bn
+from .inception import inception_bn, inception_bn_tiny
 from .bowl import kaggle_bowl
 from .kaiming import kaiming
 
 __all__ = ["mnist_mlp", "mnist_conv", "alexnet", "inception_bn",
-           "kaggle_bowl", "kaiming"]
+           "inception_bn_tiny", "kaggle_bowl", "kaiming"]

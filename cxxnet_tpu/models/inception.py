@@ -62,6 +62,49 @@ def _inception(lines: List[str], src: str, name: str,
     return name
 
 
+def inception_bn_tiny(nclass: int = 8, batch_size: int = 32,
+                      image_size: int = 64, lr: float = 0.05) -> str:
+    """Scaled-stem BN/concat net for fast accuracy gates.
+
+    Same topology class as Inception-BN — conv+batch_norm+relu stem,
+    multi-branch inception modules with ch_concat (incl. the avg-pool
+    projection branch and a stride-2 reduction module) and a
+    global-avg-pool head — at 64 px with small channel counts, so the
+    BN+concat graph converges on a synthetic task in seconds on the
+    8-device CPU mesh (tests/test_mnist_e2e.py gate). Spatial sizes are
+    chosen so the stride-2 conv branches (floor) and ceil-mode pool
+    branch agree at every concat (even extents throughout).
+    """
+    L: List[str] = ["netconfig=start"]
+    _conv_bn_relu(L, "0", "c1", "conv1", 16, 3, 1, 1)
+    L += ["layer[c1->p1] = max_pooling", "  kernel_size = 2",
+          "  stride = 2"]
+    top = "p1"
+    modules: List[Tuple] = [
+        ("t3a", 16, 8, 16, 8, 16, "avg", 16, 1),
+        ("t3b", 0, 16, 24, 8, 16, "max", 0, 2),
+        ("t4a", 24, 8, 16, 8, 16, "avg", 16, 1),
+    ]
+    for (nm, n1, n3r, n3, nd3r, nd3, pool, np_, st) in modules:
+        top = _inception(L, top, nm, n1, n3r, n3, nd3r, nd3, pool, np_, st)
+    gap = image_size // 4
+    L += ["layer[%s->gap] = avg_pooling" % top,
+          "  kernel_size = %d" % gap, "  stride = 1",
+          "layer[gap->flat] = flatten",
+          "layer[flat->fc] = fullc:fc1",
+          "  nhidden = %d" % nclass,
+          "  init_sigma = 0.01",
+          "layer[fc->fc] = softmax",
+          "netconfig=end",
+          "input_shape = 3,%d,%d" % (image_size, image_size),
+          "batch_size = %d" % batch_size,
+          "momentum = 0.9",
+          "eta = %g" % lr,
+          "random_type = xavier",
+          "metric = error"]
+    return "\n".join(L) + "\n"
+
+
 def inception_bn(nclass: int = 1000, batch_size: int = 128,
                  image_size: int = 224, lr: float = 0.01) -> str:
     L: List[str] = ["netconfig=start"]

@@ -12,11 +12,14 @@ time cost unchanged" (/root/reference/example/ImageNet/README.md:47).
 """
 
 
-def _stage(lines, idx, node, convs, pool=None):
+def _stage(lines, idx, node, convs, pool=None, fused_pools=False):
     """Append `convs` = [(nchannel, kernel, stride, pad), ...] then an
     optional (kernel, stride) max pool to `lines` in place; returns the
-    advanced (idx, node) counters."""
-    for (nch, k, s, p) in convs:
+    advanced (idx, node) counters. fused_pools folds the last relu and
+    a stride-1 pool into one relu_max_pooling layer (identical math;
+    the Pallas-kernel e2e configuration, doc/perf_profile.md r4)."""
+    n = len(convs)
+    for ci, (nch, k, s, p) in enumerate(convs):
         lines.append("layer[%d->%d] = conv:conv%d" % (node, node + 1, idx))
         lines.append("  nchannel = %d" % nch)
         lines.append("  kernel_size = %d" % k)
@@ -24,12 +27,21 @@ def _stage(lines, idx, node, convs, pool=None):
             lines.append("  stride = %d" % s)
         if p != 0:
             lines.append("  pad = %d" % p)
-        lines.append("layer[%d->%d] = relu:relu%d" % (node + 1, node + 2, idx))
-        node += 2
+        fuse_here = (fused_pools and ci == n - 1 and pool is not None
+                     and pool[1] == 1)
+        if not fuse_here:
+            lines.append("layer[%d->%d] = relu:relu%d"
+                         % (node + 1, node + 2, idx))
+            node += 2
+        else:
+            node += 1
         idx += 1
     if pool is not None:
         k, s = pool
-        lines.append("layer[%d->%d] = max_pooling:pool_s%d" % (node, node + 1, idx))
+        typ = ("relu_max_pooling" if fused_pools and s == 1
+               else "max_pooling")
+        lines.append("layer[%d->%d] = %s:pool_s%d"
+                     % (node, node + 1, typ, idx))
         lines.append("  kernel_size = %d" % k)
         if s != 1:
             lines.append("  stride = %d" % s)
@@ -38,23 +50,31 @@ def _stage(lines, idx, node, convs, pool=None):
 
 
 def kaiming(nclass: int = 1000, batch_size: int = 128,
-            image_size: int = 224, lr: float = 0.01) -> str:
+            image_size: int = 224, lr: float = 0.01,
+            fused_pools: bool = False) -> str:
     lines = ["netconfig=start"]
     # stage 1: stem
     lines += ["layer[0->1] = conv:conv1",
-              "  kernel_size = 7", "  stride = 2", "  nchannel = 64",
-              "layer[1->2] = relu:relu1",
-              "layer[2->3] = max_pooling:pool_stem",
-              "  kernel_size = 3"]
-    idx, node = 2, 3
+              "  kernel_size = 7", "  stride = 2", "  nchannel = 64"]
+    if fused_pools:
+        lines += ["layer[1->2] = relu_max_pooling:pool_stem",
+                  "  kernel_size = 3"]
+        idx, node = 2, 2
+    else:
+        lines += ["layer[1->2] = relu:relu1",
+                  "layer[2->3] = max_pooling:pool_stem",
+                  "  kernel_size = 3"]
+        idx, node = 2, 3
     # stage 2: 128-ch 2x2 convs (first one downsamples with stride 3)
     idx, node = _stage(lines, idx, node,
                        [(128, 2, 3, 0), (128, 2, 1, 1),
-                        (128, 2, 1, 0), (128, 2, 1, 1)], pool=(3, 1))
+                        (128, 2, 1, 0), (128, 2, 1, 1)], pool=(3, 1),
+                       fused_pools=fused_pools)
     # stage 3: 256-ch 2x2 convs (first one downsamples with stride 2)
     idx, node = _stage(lines, idx, node,
                        [(256, 2, 2, 0), (256, 2, 1, 1),
-                        (256, 2, 1, 0), (256, 2, 1, 1)], pool=(3, 1))
+                        (256, 2, 1, 0), (256, 2, 1, 1)], pool=(3, 1),
+                       fused_pools=fused_pools)
     # stage 4: wide 2304-ch downsampling conv + 256-ch conv
     idx, node = _stage(lines, idx, node,
                        [(2304, 2, 3, 0), (256, 2, 1, 1)])

@@ -438,7 +438,20 @@ class NetTrainer:
             n //= jax.process_count()
         return n
 
-    def _mask(self, batch: DataBatch) -> np.ndarray:
+    def _mask(self, batch: DataBatch):
+        """Row-validity mask, or None when every row is real — the
+        None specialization lets BN stats and the loss skip the
+        broadcast-mask multiplies on full-size activations (the
+        no-padding case is every steady-state batch; only epoch-tail
+        batches compile the masked variant).
+
+        Multi-process dp always materializes the mask: the None/array
+        choice selects between two compiled programs, and per-RANK
+        padding can differ on the epoch tail — ranks dispatching
+        structurally different SPMD programs would deadlock the
+        gradient collectives."""
+        if not batch.num_batch_padd and jax.process_count() == 1:
+            return None
         n = self._local_batch_size(batch)
         m = np.ones((n,), np.float32)
         if batch.num_batch_padd:
@@ -474,11 +487,15 @@ class NetTrainer:
             return x                      # already resident (test_skipread)
         return self._ship(np.asarray(x), self._b_shard)
 
+    def _put_mask(self, batch: DataBatch):
+        m = self._mask(batch)
+        return None if m is None else self._put_batch_array(m)
+
     def _device_batch(self, batch: DataBatch):
         data = self._put_batch_array(batch.data)
         labels = self._put_batch_array(batch.label)
-        mask = self._put_batch_array(self._mask(batch))
-        return data, labels, mask, self._device_extra(batch)
+        return (data, labels, self._put_mask(batch),
+                self._device_extra(batch))
 
     def device_put_batch(self, batch: DataBatch) -> DataBatch:
         """Move a batch's arrays to the device with the batch sharding.
@@ -613,7 +630,14 @@ class NetTrainer:
         step0 = self._step_scalar()
         data_k = self._put_window([b.data for b in batches])
         labels_k = self._put_window([b.label for b in batches])
-        mask_k = self._put_window([self._mask(b) for b in batches])
+        masks = [self._mask(b) for b in batches]
+        if all(m is None for m in masks):
+            mask_k = None
+        else:       # mixed window: materialize ones for unpadded rows
+            mask_k = self._put_window(
+                [np.ones((self._local_batch_size(b),), np.float32)
+                 if m is None else m
+                 for m, b in zip(masks, batches)])
         n_extra = len(batches[0].extra_data)
         extra_k = tuple(
             self._put_window([b.extra_data[j] for b in batches])
@@ -656,8 +680,7 @@ class NetTrainer:
             # nnet_impl-inl.hpp:241-276)
             vals = self._pred_step(self.params, self.net_state,
                                    self._put_batch_array(batch.data),
-                                   self._put_batch_array(
-                                       self._mask(batch)),
+                                   self._put_mask(batch),
                                    self._device_extra(batch),
                                    nodes_wanted=nodes_wanted)
             nvalid = self._local_batch_size(batch) - batch.num_batch_padd
@@ -673,8 +696,7 @@ class NetTrainer:
         top = self.graph.num_nodes - 1
         (val,) = self._pred_step(self.params, self.net_state,
                                  self._put_batch_array(batch.data),
-                                 self._put_batch_array(
-                                     self._mask(batch)),
+                                 self._put_mask(batch),
                                  self._device_extra(batch),
                                  nodes_wanted=(top,))
         nvalid = self._local_batch_size(batch) - batch.num_batch_padd
@@ -687,8 +709,7 @@ class NetTrainer:
         ni = self.net.node_index_by_name(node)
         (val,) = self._pred_step(self.params, self.net_state,
                                  self._put_batch_array(batch.data),
-                                 self._put_batch_array(
-                                     self._mask(batch)),
+                                 self._put_mask(batch),
                                  self._device_extra(batch),
                                  nodes_wanted=(ni,))
         nvalid = self._local_batch_size(batch) - batch.num_batch_padd

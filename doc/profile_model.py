@@ -65,7 +65,9 @@ def profile(model: str = "inception_bn", batch: int = 0,
     builder = getattr(zoo, model)
     t = NetTrainer(parse_config(builder(nclass=1000, batch_size=batch,
                                         image_size=size))
-                   + [("eval_train", "0"), ("dtype", "bfloat16")])
+                   + [("eval_train", "0"), ("dtype", "bfloat16")]
+                   + [kv.split("=", 1) for kv in
+                      os.environ.get("PROFILE_EXTRA", "").split(",") if kv])
     t.init_model()
     rng = np.random.RandomState(0)
     b = DataBatch(

@@ -80,3 +80,71 @@ def test_pallas_fullc_trains(rng):
         t.update(DataBatch(data=data, label=label))
         losses.append(t.last_loss)
     assert np.isfinite(losses[-1]) and losses[-1] < losses[0]
+
+
+def test_pallas_relu_max_pool_matches_xla(rng):
+    """Fused relu+maxpool kernel vs relu -> reduce_window, fwd + bwd.
+
+    Tie semantics: the Pallas backward credits EVERY input equal to the
+    window max (the reference's unpool), XLA's select-and-scatter only
+    the first — continuous random data has no positive ties, so both
+    paths must agree exactly there; the relu mask zeroes the x<=0
+    region where relu-induced ties live.
+    """
+    from cxxnet_tpu.layers.pallas_kernels import relu_max_pool
+
+    for shape, k in [((2, 9, 9, 8), 3), ((3, 12, 10, 16), 3),
+                     ((2, 7, 7, 8), 2)]:
+        x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+
+        def ref(a):
+            r = jax.nn.relu(a)
+            return jax.lax.reduce_window(
+                r, -jnp.inf, jax.lax.max, (1, k, k, 1), (1, 1, 1, 1),
+                "VALID")
+
+        y = relu_max_pool(x, k)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref(x)),
+                                   atol=1e-6)
+        g = jax.grad(lambda a: jnp.sum(relu_max_pool(a, k) ** 2))(x)
+        g_ref = jax.grad(lambda a: jnp.sum(ref(a) ** 2))(x)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   atol=1e-5)
+
+
+def test_pairtest_pallas_relu_max_pooling(rng):
+    """pairtest-relu_max_pooling-pallas_relu_max_pooling: the VERDICT
+    r3 §4 validation flow for the fused stem-pool kernel."""
+    layer = create_layer("pairtest-relu_max_pooling-pallas_relu_max_pooling",
+                         [("kernel_size", "3"), ("stride", "1")])
+    layer.infer_shape([Shape3(8, 11, 11)])
+    params = layer.init_params(jax.random.PRNGKey(0))
+    state = layer.init_state()
+    x = jnp.asarray(rng.randn(4, 11, 11, 8).astype(np.float32))
+    outs, new_state = layer.forward(params, state, [x], True, None)
+    assert float(new_state["pairtest:max_diff"]) < 1e-6
+
+
+def test_pallas_relu_max_pool_chunked(rng, monkeypatch):
+    """Force the H-chunked halo path (production stems chunk; the small
+    shapes above take the single-call path) and check fwd + the
+    overlapping-halo bwd accumulation against XLA."""
+    from cxxnet_tpu.layers import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "_chunk_rows", lambda *a, **k: 8)
+    x = jnp.asarray(rng.randn(2, 30, 13, 8).astype(np.float32))
+
+    def ref(a):
+        r = jax.nn.relu(a)
+        return jax.lax.reduce_window(
+            r, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 1, 1, 1),
+            "VALID")
+
+    y = pk.relu_max_pool(x, 3)
+    assert y.shape == (2, 28, 11, 8)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref(x)),
+                               atol=1e-6)
+    g = jax.grad(lambda a: jnp.sum(pk.relu_max_pool(a, 3) ** 2))(x)
+    g_ref = jax.grad(lambda a: jnp.sum(ref(a) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               atol=1e-5)

@@ -356,3 +356,33 @@ print_step = 0
     txt = "\n".join(logs)
     assert "test-rmse[tags]:" in txt
     assert os.path.exists(str(tmp_path / "models" / "0002.model.npz"))
+
+
+def test_imglist_short_rows_zero_pad(tmp_path):
+    """A remap list whose rows carry fewer labels than label_width must
+    zero-pad (not crash on the trailing path token)."""
+    import cv2
+    from cxxnet_tpu.io.iter_imgrec import ImageRecordIterator
+
+    rec = str(tmp_path / "s.rec")
+    w = RecordIOWriter(rec, force_python=True)
+    img = (np.ones((8, 8, 3)) * 100).astype(np.uint8)
+    ok, enc = cv2.imencode(".png", img)
+    for i in range(4):
+        w.write_record(pack_image_record(i, 0.0, enc.tobytes()))
+    w.close()
+    lst = tmp_path / "map.lst"
+    lst.write_text("0\t1.0\ta.png\n1\t2.0\t5.0\tb.png\n"
+                   "2\t3.0\t6.0\t9.0\tc.png\n3\t4.0\td.png\n")
+    it = ImageRecordIterator()
+    it.set_param("path_imgrec", rec)
+    it.set_param("path_imglist", str(lst))
+    it.set_param("label_width", "3")
+    it.set_param("silent", "1")
+    it.init()
+    got = {}
+    while it.next():
+        v = it.value()
+        got[v.index] = list(v.label)
+    assert got == {0: [1.0, 0.0, 0.0], 1: [2.0, 5.0, 0.0],
+                   2: [3.0, 6.0, 9.0], 3: [4.0, 0.0, 0.0]}
