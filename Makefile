@@ -77,9 +77,9 @@ bin/mex_driver: wrapper/matlab/mex_driver.cc \
 
 # ---- release bar -----------------------------------------------------
 # `make check` is THE release gate: the FULL suite including the e2e
-# accuracy gates (MNIST MLP ~12s, MNIST conv ~7min, bf16-grad conv
-# ~7min, BN/concat inception gate ~2min). Expected wall time ~25-30min
-# on this 1-core host; `make check-fast` (~10min) skips only the MNIST
+# accuracy gates (MNIST MLP, two ~20min MNIST conv gates, BN/concat
+# inception gate). Expected wall time ~55min observed on this 1-core
+# host; `make check-fast` (~15min) skips only the MNIST
 # e2e gates and is NOT sufficient for a release.
 check: all
 	python -m pytest tests/ -q

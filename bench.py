@@ -207,6 +207,12 @@ def main():
                     default="float32",
                     help="gradient/cotangent dtype (f32 master weights "
                          "either way)")
+    ap.add_argument("--extra", action="append", default=[],
+                    metavar="K=V",
+                    help="extra config pairs for perf experiments "
+                         "(e.g. --extra bn_fold_affine=0), the CLI "
+                         "face of measure(extra=...); same role as "
+                         "profile_model.py's PROFILE_EXTRA")
     args = ap.parse_args()
     if args.pipeline or args.pipeline_raw:
         e2e, duty, pure, eval_ips = measure_pipeline(
@@ -225,7 +231,8 @@ def main():
         model = args.model
         steps = args.steps if args.steps is not None else 200
         ips = measure(steps=steps, batch=args.batch, model=model,
-                      grad_dtype=args.grad_dtype)
+                      grad_dtype=args.grad_dtype,
+                      extra=tuple(kv.split("=", 1) for kv in args.extra))
         # 'AlexNet' spelling keeps the canonical BENCH metric name
         # stable across rounds
         name = "AlexNet" if model == "alexnet" else model
@@ -246,8 +253,9 @@ def main():
     models = {}
     for m in sorted(MODELS):
         steps = args.steps if args.steps is not None else 200
-        models[m] = round(measure(steps=steps, model=m,
-                                  grad_dtype=args.grad_dtype), 1)
+        models[m] = round(measure(
+            steps=steps, model=m, grad_dtype=args.grad_dtype,
+            extra=tuple(kv.split("=", 1) for kv in args.extra)), 1)
         gc.collect()                     # free HBM before the next model
     ips = models["alexnet"]
     print(json.dumps({

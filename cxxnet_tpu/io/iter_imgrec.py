@@ -120,6 +120,13 @@ class ImageRecordIterator(IIterator):
                         try:
                             vals.append(float(t))
                         except ValueError:
+                            # the trailing path token legitimately ends
+                            # the numeric prefix; a non-numeric token
+                            # BEFORE it is a malformed row — warn, do
+                            # not silently zero-fill a typo'd label
+                            if t is not toks[-1]:
+                                print("imglist: non-numeric label %r "
+                                      "in row %r" % (t, line.strip()))
                             break
                     lab = np.zeros((self.label_width,), np.float32)
                     lab[:len(vals)] = vals

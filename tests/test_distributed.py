@@ -562,3 +562,54 @@ def test_launch_py_two_process(tmp_path):
     assert (tmp_path / "cli_models" / "0002.model.npz").exists(), txt
     # rank-prefixed streams from both workers
     assert "[0]" in txt and "[1]" in txt, txt
+
+
+def test_cli_two_process_divergent_padding(tmp_path):
+    """Regression for the round-4 reviewer finding: the maskless
+    specialization (mask=None when a rank's batch has no tail padding)
+    selects between two COMPILED PROGRAMS; with 15 rows rank-strided,
+    rank0 gets 8 rows (2 exact local-batch-4 batches) while rank1 gets
+    7 (its second batch padded) — if the None/array choice were made
+    per rank, the ranks would dispatch structurally different SPMD
+    programs in the same step and the gradient collectives would hang.
+    Multi-process mode must always materialize the mask."""
+    rng = np.random.RandomState(11)
+    X = rng.rand(15, 10).astype(np.float32)
+    y = (X @ rng.randn(10, 4)).argmax(1)
+    with open(tmp_path / "odd.csv", "w") as f:
+        for i in range(15):
+            f.write(",".join([str(y[i])] + ["%g" % v for v in X[i]])
+                    + "\n")
+    (tmp_path / "cli.conf").write_text(
+        CLI_CONF_ODD % (tmp_path, tmp_path, tmp_path))
+    script = str(tmp_path / "cli_worker.py")
+    with open(script, "w") as f:
+        f.write(CLI_WORKER % {"repo": REPO})
+
+    port = _free_port()
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "CXXNET_COORDINATOR": "127.0.0.1:%d" % port,
+            "CXXNET_NUM_PROCESSES": "2",
+            "CXXNET_PROCESS_ID": str(r),
+            "CXXNET_TEST_WORKDIR": str(tmp_path),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    try:
+        for r, p in enumerate(procs):
+            # a deadlock (per-rank None/array divergence) trips this
+            out, _ = p.communicate(timeout=300)
+            txt = out.decode(errors="replace")
+            assert p.returncode == 0, "rank %d failed:\n%s" % (r, txt)
+            assert ("CLIWORKER%d OK" % r) in txt, txt
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+    assert (tmp_path / "odd_models" / "0002.model.npz").exists()

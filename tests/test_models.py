@@ -91,9 +91,10 @@ def test_models_train_step(conf_fn, shape, nclass):
 
 def test_inception_train_step_tiny():
     """One update of the scaled-stem BN/concat variant at 64 px (the
-    full-size 224 conf is covered by test_models_train_step; the 112-px
-    conf can't build — stride-2 conv floor vs ceil-mode pool disagree
-    at odd extents, which is why the tiny variant exists)."""
+    full-size 224 conf trains a step in
+    test_inception_bn_multidevice_real_shapes below; the 112-px conf
+    can't build — stride-2 conv floor vs ceil-mode pool disagree at
+    odd extents, which is why the tiny variant exists)."""
     from cxxnet_tpu.models import inception_bn_tiny
     t = NetTrainer(parse_config(inception_bn_tiny(nclass=8, batch_size=4,
                                                   image_size=64)))
