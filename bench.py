@@ -214,6 +214,10 @@ def main():
                          "face of measure(extra=...); same role as "
                          "profile_model.py's PROFILE_EXTRA")
     args = ap.parse_args()
+    for kv in args.extra:
+        if "=" not in kv:
+            ap.error("--extra expects K=V, got %r" % kv)
+    extra_cfg = tuple(kv.split("=", 1) for kv in args.extra)
     if args.pipeline or args.pipeline_raw:
         e2e, duty, pure, eval_ips = measure_pipeline(
             raw=args.pipeline_raw)
@@ -231,8 +235,7 @@ def main():
         model = args.model
         steps = args.steps if args.steps is not None else 200
         ips = measure(steps=steps, batch=args.batch, model=model,
-                      grad_dtype=args.grad_dtype,
-                      extra=tuple(kv.split("=", 1) for kv in args.extra))
+                      grad_dtype=args.grad_dtype, extra=extra_cfg)
         # 'AlexNet' spelling keeps the canonical BENCH metric name
         # stable across rounds
         name = "AlexNet" if model == "alexnet" else model
@@ -255,7 +258,7 @@ def main():
         steps = args.steps if args.steps is not None else 200
         models[m] = round(measure(
             steps=steps, model=m, grad_dtype=args.grad_dtype,
-            extra=tuple(kv.split("=", 1) for kv in args.extra)), 1)
+            extra=extra_cfg), 1)
         gc.collect()                     # free HBM before the next model
     ips = models["alexnet"]
     print(json.dumps({

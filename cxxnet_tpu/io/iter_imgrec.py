@@ -122,9 +122,10 @@ class ImageRecordIterator(IIterator):
                         except ValueError:
                             # the trailing path token legitimately ends
                             # the numeric prefix; a non-numeric token
-                            # BEFORE it is a malformed row — warn, do
-                            # not silently zero-fill a typo'd label
-                            if t is not toks[-1]:
+                            # BEFORE it usually means a malformed row
+                            # (or a path with spaces) — warn rather
+                            # than silently zero-fill a typo'd label
+                            if t is not toks[-1] and self.silent == 0:
                                 print("imglist: non-numeric label %r "
                                       "in row %r" % (t, line.strip()))
                             break

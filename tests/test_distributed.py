@@ -379,20 +379,13 @@ silent = 1
 """
 
 
-def test_cli_two_process_training(tmp_path):
-    rng = np.random.RandomState(3)
-    X = rng.rand(32, 10).astype(np.float32)
-    y = (X @ rng.randn(10, 4)).argmax(1)
-    with open(tmp_path / "cli.csv", "w") as f:
-        for i in range(32):
-            f.write(",".join([str(y[i])] + ["%g" % v for v in X[i]])
-                    + "\n")
-    (tmp_path / "cli.conf").write_text(CLI_CONF
-                                       % (tmp_path, tmp_path))
+def _run_two_cli_ranks(tmp_path, timeout=300):
+    """Launch the CLI worker script on 2 coordinated ranks and assert
+    both exit 0 with their OK marker (shared harness for the
+    two-process CLI tests; a collective deadlock trips the timeout)."""
     script = str(tmp_path / "cli_worker.py")
     with open(script, "w") as f:
         f.write(CLI_WORKER % {"repo": REPO})
-
     port = _free_port()
     procs = []
     for r in range(2):
@@ -410,7 +403,7 @@ def test_cli_two_process_training(tmp_path):
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
     try:
         for r, p in enumerate(procs):
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=timeout)
             txt = out.decode(errors="replace")
             assert p.returncode == 0, "rank %d failed:\n%s" % (r, txt)
             assert ("CLIWORKER%d OK" % r) in txt, txt
@@ -418,6 +411,19 @@ def test_cli_two_process_training(tmp_path):
         for q in procs:
             if q.poll() is None:
                 q.kill()
+
+
+def test_cli_two_process_training(tmp_path):
+    rng = np.random.RandomState(3)
+    X = rng.rand(32, 10).astype(np.float32)
+    y = (X @ rng.randn(10, 4)).argmax(1)
+    with open(tmp_path / "cli.csv", "w") as f:
+        for i in range(32):
+            f.write(",".join([str(y[i])] + ["%g" % v for v in X[i]])
+                    + "\n")
+    (tmp_path / "cli.conf").write_text(CLI_CONF
+                                       % (tmp_path, tmp_path))
+    _run_two_cli_ranks(tmp_path)
 
     # root-only snapshots exist for both rounds
     assert (tmp_path / "cli_models" / "0001.model.npz").exists()
@@ -473,36 +479,8 @@ def test_cli_two_process_unequal_shards(tmp_path):
                     + "\n")
     (tmp_path / "cli.conf").write_text(
         CLI_CONF_ODD % (tmp_path, tmp_path, tmp_path))
-    script = str(tmp_path / "cli_worker.py")
-    with open(script, "w") as f:
-        f.write(CLI_WORKER % {"repo": REPO})
-
-    port = _free_port()
-    procs = []
-    for r in range(2):
-        env = dict(os.environ)
-        env.pop("XLA_FLAGS", None)
-        env.update({
-            "JAX_PLATFORMS": "cpu",
-            "CXXNET_COORDINATOR": "127.0.0.1:%d" % port,
-            "CXXNET_NUM_PROCESSES": "2",
-            "CXXNET_PROCESS_ID": str(r),
-            "CXXNET_TEST_WORKDIR": str(tmp_path),
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, script], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-    try:
-        for r, p in enumerate(procs):
-            # a deadlock (the pre-fix behavior) trips this timeout
-            out, _ = p.communicate(timeout=300)
-            txt = out.decode(errors="replace")
-            assert p.returncode == 0, "rank %d failed:\n%s" % (r, txt)
-            assert ("CLIWORKER%d OK" % r) in txt, txt
-    finally:
-        for q in procs:
-            if q.poll() is None:
-                q.kill()
+    # a deadlock (the pre-fix behavior) trips the harness timeout
+    _run_two_cli_ranks(tmp_path)
     assert (tmp_path / "odd_models" / "0002.model.npz").exists()
 
 
@@ -582,34 +560,6 @@ def test_cli_two_process_divergent_padding(tmp_path):
                     + "\n")
     (tmp_path / "cli.conf").write_text(
         CLI_CONF_ODD % (tmp_path, tmp_path, tmp_path))
-    script = str(tmp_path / "cli_worker.py")
-    with open(script, "w") as f:
-        f.write(CLI_WORKER % {"repo": REPO})
-
-    port = _free_port()
-    procs = []
-    for r in range(2):
-        env = dict(os.environ)
-        env.pop("XLA_FLAGS", None)
-        env.update({
-            "JAX_PLATFORMS": "cpu",
-            "CXXNET_COORDINATOR": "127.0.0.1:%d" % port,
-            "CXXNET_NUM_PROCESSES": "2",
-            "CXXNET_PROCESS_ID": str(r),
-            "CXXNET_TEST_WORKDIR": str(tmp_path),
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, script], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-    try:
-        for r, p in enumerate(procs):
-            # a deadlock (per-rank None/array divergence) trips this
-            out, _ = p.communicate(timeout=300)
-            txt = out.decode(errors="replace")
-            assert p.returncode == 0, "rank %d failed:\n%s" % (r, txt)
-            assert ("CLIWORKER%d OK" % r) in txt, txt
-    finally:
-        for q in procs:
-            if q.poll() is None:
-                q.kill()
+    # a deadlock (per-rank None/array divergence) trips the timeout
+    _run_two_cli_ranks(tmp_path)
     assert (tmp_path / "odd_models" / "0002.model.npz").exists()

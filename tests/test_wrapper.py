@@ -129,3 +129,59 @@ def test_net_requires_init():
     net = Net(cfg=NET_CFG)
     with pytest.raises(RuntimeError):
         net.predict(np.zeros((8, 1, 1, 10), np.float32))
+
+
+def test_net_multilabel_through_wrapper(tmp_path):
+    """label_width=3 through the Python wrapper: a csv whose rows carry
+    three binary labels feeds a multi_logistic + label_vec net via
+    DataIter, and update from an explicit (batch, 3) ndarray label
+    works too."""
+    rng = np.random.RandomState(2)
+    X = rng.rand(16, 10).astype(np.float32)
+    Y = rng.randint(0, 2, (16, 3)).astype(np.float32)
+    p = tmp_path / "ml.csv"
+    with open(p, "w") as f:
+        for i in range(16):
+            f.write(",".join(["%g" % v for v in Y[i]] +
+                             ["%.6f" % v for v in X[i]]) + "\n")
+    cfg = """
+label_vec[0,3) = tags
+netconfig = start
+layer[0->1] = fullc:fc1
+  nhidden = 8
+layer[1->2] = relu
+layer[2->3] = fullc:fc2
+  nhidden = 3
+layer[3->3] = multi_logistic
+  target = tags
+netconfig = end
+input_shape = 1,1,10
+label_width = 3
+batch_size = 8
+eta = 0.1
+metric[tags] = rmse
+"""
+    it = DataIter("""
+iter = csv
+  filename = %s
+  input_shape = 1,1,10
+  label_width = 3
+iter = end
+batch_size = 8
+""" % p)
+    assert it.next()
+    lab = it.get_label()
+    assert lab.shape == (8, 3)
+    np.testing.assert_allclose(lab, Y[:8])
+
+    net = Net(cfg=cfg)
+    net.init_model()
+    for r in range(2):
+        net.start_round(r)
+        it.before_first()
+        while it.next():
+            net.update(it)
+    # ndarray update with a (batch, 3) label matrix
+    net.update(X[:8].reshape(8, 1, 1, 10), Y[:8])
+    s = net.evaluate(it, "ev")
+    assert "ev-rmse[tags]:" in s
