@@ -106,7 +106,11 @@ class ImageRecordIterator(IIterator):
             self._label_map = {}
             with open_stream(self.path_imglist, "r") as f:
                 for line in f:
-                    toks = line.split()
+                    # bound the split so an image path containing
+                    # spaces stays ONE trailing token (reference reads
+                    # the path with getline after the labels,
+                    # iter_image_recordio-inl.hpp:120-147)
+                    toks = line.split(None, 1 + self.label_width)
                     if not toks:
                         continue
                     idx = int(float(toks[0]))
@@ -121,11 +125,11 @@ class ImageRecordIterator(IIterator):
                             vals.append(float(t))
                         except ValueError:
                             # the trailing path token legitimately ends
-                            # the numeric prefix; a non-numeric token
-                            # BEFORE it usually means a malformed row
-                            # (or a path with spaces) — warn rather
-                            # than silently zero-fill a typo'd label
-                            if t is not toks[-1] and self.silent == 0:
+                            # the numeric prefix (short rows zero-pad);
+                            # a non-numeric token BEFORE it is a
+                            # malformed row — warn rather than silently
+                            # zero-fill a typo'd label
+                            if t is not toks[-1]:
                                 print("imglist: non-numeric label %r "
                                       "in row %r" % (t, line.strip()))
                             break

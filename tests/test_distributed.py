@@ -128,7 +128,7 @@ def test_two_process_bringup(tmp_path):
     outs = []
     try:
         for r, p in enumerate(procs):
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=600)
             outs.append(out.decode(errors="replace"))
             assert p.returncode == 0, \
                 "rank %d failed:\n%s" % (r, outs[-1])
@@ -288,7 +288,7 @@ def test_cross_process_training_equivalence(tmp_path):
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
     try:
         for r, p in enumerate(procs):
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=600)
             txt = out.decode(errors="replace")
             assert p.returncode == 0, "rank %d failed:\n%s" % (r, txt)
             assert ("TRAINWORKER%d OK" % r) in txt, txt
@@ -312,7 +312,7 @@ def test_cross_process_training_equivalence(tmp_path):
     env.pop("CXXNET_COORDINATOR", None)
     out = subprocess.run([sys.executable, script1], env=env,
                          stdout=subprocess.PIPE,
-                         stderr=subprocess.STDOUT, timeout=300)
+                         stderr=subprocess.STDOUT, timeout=600)
     assert out.returncode == 0, out.stdout.decode(errors="replace")
 
     # --- final parameters match across the process boundary
@@ -379,7 +379,7 @@ silent = 1
 """
 
 
-def _run_two_cli_ranks(tmp_path, timeout=300):
+def _run_two_cli_ranks(tmp_path, timeout=600):
     """Launch the CLI worker script on 2 coordinated ranks and assert
     both exit 0 with their OK marker (shared harness for the
     two-process CLI tests; a collective deadlock trips the timeout)."""
