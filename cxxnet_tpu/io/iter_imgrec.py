@@ -129,7 +129,7 @@ class ImageRecordIterator(IIterator):
                             # a non-numeric token BEFORE it is a
                             # malformed row — warn rather than silently
                             # zero-fill a typo'd label
-                            if t is not toks[-1]:
+                            if t is not toks[-1] and self.silent == 0:
                                 print("imglist: non-numeric label %r "
                                       "in row %r" % (t, line.strip()))
                             break

@@ -277,8 +277,10 @@ def pack_image_record(index: int, label, img_bytes: bytes,
     if lab.size > 1:
         assert flag == 0, "multi-label packs its own flag"
         flag = MULTI_LABEL_TAG | lab.size
+        # extra labels little-endian like the '<'-prefixed header, so
+        # archives stay portable across host byte orders
         return (_HDR.pack(flag, float(lab[0]), index, 0)
-                + lab[1:].tobytes() + img_bytes)
+                + lab[1:].astype("<f4").tobytes() + img_bytes)
     return _HDR.pack(flag, float(lab[0]), index, 0) + img_bytes
 
 
@@ -289,8 +291,9 @@ def parse_image_record(rec: bytes):
     w = multi_label_width(flag)
     if w == 0:
         return int(id0), float(label), None, rec[_HDR.size:]
-    extra = np.frombuffer(rec, np.float32, w - 1, _HDR.size)
-    labels = np.concatenate([[np.float32(label)], extra])
+    extra = np.frombuffer(rec, "<f4", w - 1, _HDR.size)
+    labels = np.concatenate([[np.float32(label)], extra]).astype(
+        np.float32)
     return int(id0), float(label), labels, rec[_HDR.size + 4 * (w - 1):]
 
 

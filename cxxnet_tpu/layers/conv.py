@@ -452,10 +452,16 @@ class BatchNormLayer(Layer):
         if is_train:
             mean, var = self._moments(x, mask)
             if self.param.bn_fold_affine:
-                # fold normalize+affine into per-channel scale/shift
-                # (computed in f32, applied in the compute dtype): the
-                # full-tensor path is one fused multiply-add instead of
-                # an f32-upcast sub/mul/mul/add chain
+                # fold normalize+affine into per-channel scale/shift:
+                # scale/shift are computed in f32 but APPLIED in the
+                # compute dtype, so under bfloat16 the full-tensor
+                # multiply-add runs in bf16 — unlike the unfused branch
+                # and the eval path below, whose f32 scale broadcast
+                # promotes the arithmetic to f32. The ~3-bit mantissa
+                # loss is per-element rounding on an O(1)-magnitude
+                # normalized tensor (bf16 BN agreement + gate coverage:
+                # test_layers.py::test_batch_norm_fold_bf16,
+                # test_inception_gate.py)
                 scale = slope * jax.lax.rsqrt(var + self.eps)
                 shift = bias - mean * scale
                 out = x * scale.astype(x.dtype) + shift.astype(x.dtype)

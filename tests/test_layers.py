@@ -197,6 +197,39 @@ def test_batch_norm_train_and_running(rng):
                                atol=1e-4)
 
 
+def test_batch_norm_fold_bf16(rng):
+    """bn_fold_affine (default on) applies scale/shift in the compute
+    dtype, so under bfloat16 the normalize multiply-add runs in bf16
+    while the unfused branch and the eval path promote to f32
+    (conv.py forward). This pins the precision contract: folded-bf16
+    must agree with unfused-bf16 and with the f32 reference to within
+    bf16 rounding (~3 bits on an O(1) normalized tensor)."""
+    x32 = rng.randn(8, 5, 5, 6).astype(np.float32)
+    x16 = jnp.asarray(x32, jnp.bfloat16)
+    outs = {}
+    for fold in ("0", "1"):
+        # bn_momentum=0: one train step writes the running stats to
+        # exactly this batch's moments, so the eval branch is
+        # comparable against the same reference
+        layer, params, state, o, new_state = run_layer(
+            "batch_norm", [("bn_fold_affine", fold),
+                           ("bn_momentum", "0")], [(6, 5, 5)],
+            [x16], is_train=True)
+        assert o[0].dtype == jnp.bfloat16
+        outs[fold] = np.asarray(o[0], np.float32)
+        # eval through the running stats updated by this train step
+        eo, _ = layer.forward(params, new_state, [x16], False, None)
+        outs[fold + "eval"] = np.asarray(eo[0], np.float32)
+    mean = x32.mean(axis=(0, 1, 2))
+    ref = (x32 - mean) / np.sqrt(x32.var(axis=(0, 1, 2)) + 1e-10)
+    for key in outs:
+        np.testing.assert_allclose(outs[key], ref, atol=0.06,
+                                   err_msg="bf16 BN path %r" % key)
+    # fold on/off must agree to bf16 rounding, train AND eval
+    np.testing.assert_allclose(outs["1"], outs["0"], atol=0.04)
+    np.testing.assert_allclose(outs["1eval"], outs["0eval"], atol=0.04)
+
+
 def test_batch_norm_no_ma_eval_uses_batch_stats(rng):
     x = rng.randn(6, 5).astype(np.float32)
     layer, params, state, outs, _ = run_layer(

@@ -147,6 +147,40 @@ def test_im2rec_tool_and_imgrec_iterator(tmp_path):
 
 
 @pytest.mark.skipif(not _HAVE_TOOLS, reason="im2rec not built")
+def test_im2rec_spaced_paths(tmp_path):
+    """Image paths containing spaces pack intact: the native tool reads
+    the rest of the line as the path (same bounded-split rule commit
+    dea129b gave the Python imglist parser), instead of truncating at
+    the first whitespace token and silently skipping the row."""
+    import cv2
+    d = tmp_path / "my imgs"
+    d.mkdir()
+    rng = np.random.RandomState(0)
+    names = ["cat 01.jpg", "dog 02.jpg"]
+    for fn in names:
+        cv2.imwrite(str(d / fn),
+                    rng.randint(0, 255, (16, 16, 3), np.uint8))
+    lst = tmp_path / "img.lst"
+    lst.write_text("".join("%d\t%d\tmy imgs/%s\n" % (i, i, fn)
+                           for i, fn in enumerate(names)))
+    rec = str(tmp_path / "sp.rec")
+    subprocess.check_call([os.path.join(REPO, "bin/im2rec"),
+                           str(lst), str(tmp_path) + "/", rec],
+                          stdout=subprocess.DEVNULL)
+    r = RecordIOReader(rec)
+    seen = []
+    while True:
+        raw = r.next_record()
+        if raw is None:
+            break
+        idx, label, payload = unpack_image_record(raw)
+        assert cv2.imdecode(np.frombuffer(payload, np.uint8),
+                            cv2.IMREAD_COLOR) is not None
+        seen.append((idx, label))
+    assert seen == [(0, 0.0), (1, 1.0)]
+
+
+@pytest.mark.skipif(not _HAVE_TOOLS, reason="im2rec not built")
 def test_im2rec_resize(tmp_path):
     lst, root = _write_jpegs(tmp_path, n=4, size=40)
     rec = str(tmp_path / "r.rec")

@@ -108,24 +108,32 @@ int main(int argc, char *argv[]) {
       }
       extra_labels.push_back(static_cast<float>(tmp));
     }
-    if (!(is >> path)) {
+    // the path is the REST of the line (paths may contain spaces —
+    // same bounded-split rule as the Python imglist parser), trimmed
+    // of surrounding whitespace and any \r from CRLF lists
+    std::getline(is, path);
+    std::string::size_type b = path.find_first_not_of(" \t\r\n");
+    if (b == std::string::npos) {
       std::fprintf(stderr, "list row missing image path: %s\n",
                    line.c_str());
       return 1;
     }
-    // a purely numeric "path" with tokens still left on the row means
-    // the list has MORE labels than label_width — a silent misparse
-    // (each row would be skipped as unreadable and the tool would
-    // exit 0 with an empty archive). Guarded by a trailing-token check
-    // so legitimately numeric basenames in a plain list still pack.
+    path = path.substr(b, path.find_last_not_of(" \t\r\n") - b + 1);
+    // a purely numeric FIRST path token with more tokens after it
+    // means the list likely has MORE labels than label_width — a
+    // silent misparse (the "path" would fail to open and each row be
+    // skipped, the tool exiting 0 with an empty archive). Spaced paths
+    // whose first token is non-numeric pack fine.
+    std::istringstream ps(path);
+    std::string tok0, trailing;
+    ps >> tok0;
     char *endp = nullptr;
-    std::strtod(path.c_str(), &endp);
-    std::string trailing;
-    if (endp != nullptr && *endp == '\0' && (is >> trailing)) {
+    std::strtod(tok0.c_str(), &endp);
+    if (endp != nullptr && *endp == '\0' && (ps >> trailing)) {
       std::fprintf(stderr,
                    "numeric path token %s followed by %s — does the "
                    "list have more labels than label_width=%d?\n",
-                   path.c_str(), trailing.c_str(), label_width);
+                   tok0.c_str(), trailing.c_str(), label_width);
       return 1;
     }
     std::string full = root + path;
