@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 import numpy as np
 
 from .base import Layer, LayerParam, Shape3
@@ -181,6 +182,11 @@ class ConvolutionLayer(Layer):
         # f32 round-trips were a wall of convert fusions in the profile
         if p.no_bias == 0:
             y = y + params["bias"].astype(y.dtype)
+        # named for the remat=conv policy (trainer._wrap_loss_fn): under
+        # save_only_these_names("conv_out") the backward keeps conv
+        # outputs and recomputes BN/activation/pool between them;
+        # identity when no checkpoint policy is active
+        y = checkpoint_name(y, "conv_out")
         return [y], state
 
 

@@ -80,6 +80,17 @@ class NetTrainer:
         #                                  master weights in the updater
         self.save_optimizer = 0          # 1: checkpoint momentum/adam
         #                                  state for seamless resume
+        self.remat = "none"              # rematerialization policy for
+        #                                  the backward pass: none |
+        #                                  full | dots | conv (see
+        #                                  _wrap_loss_fn)
+        self.remat_barrier = 1           # 0: drop checkpoint's CSE
+        #                                  barriers (XLA then undoes
+        #                                  the recompute — see
+        #                                  _wrap_loss_fn)
+        self.dispatch_period = 8         # multi-process lockstep window
+        #                                  (shared with the CLI loop's
+        #                                  windowed dispatch)
         self.sample_counter = 0          # within accumulation window
         self.update_counter = 0          # applied updates (schedule epoch)
         self.round = 0
@@ -112,6 +123,14 @@ class NetTrainer:
                 self.grad_dtype = val
             if name == "save_optimizer":
                 self.save_optimizer = int(val)
+            if name == "remat":
+                if val not in ("none", "0", "full", "dots", "conv"):
+                    raise ValueError("remat must be none|full|dots|conv")
+                self.remat = "none" if val == "0" else val
+            if name == "remat_barrier":
+                self.remat_barrier = int(val)
+            if name == "dispatch_period":
+                self.dispatch_period = max(1, int(val))
             if name in ("shard_optimizer", "update_on_server"):
                 # update_on_server=1 meant "optimizer state lives off the
                 # workers" (nnet_ps_server.cpp); here it means "optimizer
@@ -277,6 +296,53 @@ class NetTrainer:
             return jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), grads)
 
+        def _wrap_loss_fn():
+            """Rematerialization policy over the shared loss body.
+
+            The reference trades compute for memory under an explicit
+            budget (im2col chunking via temp_col_max,
+            convolution_layer-inl.hpp:189-204); the TPU analogue is
+            ``jax.checkpoint`` over the loss function, trading backward
+            HBM activation traffic for recompute on the (mostly idle —
+            doc/perf_profile.md roofline) MXU:
+
+            * full — save only the step inputs; backward recomputes the
+              entire forward.
+            * dots — save dot_general (FC) outputs; recompute
+              everything else (convs included — they are not dots).
+            * conv — save ONLY conv-layer outputs (tagged ``conv_out``
+              in layers/conv.py); FC dots, BN, activations and pools
+              are recomputed.
+
+            remat_barrier=0 drops the optimization barriers
+            (prevent_cse=False). Measured (doc/perf_profile.md r5):
+            the forward and its backward recompute live in the SAME
+            XLA computation here (value_and_grad inside one step), so
+            without barriers XLA CSEs the recompute against the stored
+            forward and the program returns to the remat=none baseline
+            — no cost, but no memory savings either. Barriers stay the
+            default because guaranteed recompute is the knob's purpose
+            (HBM capacity).
+            """
+            fn = (lambda p, s, d, l, m, e, r:
+                  net.loss_fn(p, s, d, l, m, extra=e, rng=r,
+                              collect_nodes=metric_nodes))
+            if self.remat == "none":
+                return fn
+            barrier = bool(self.remat_barrier)
+            if self.remat == "full":
+                return jax.checkpoint(fn, prevent_cse=barrier)
+            if self.remat == "dots":
+                return jax.checkpoint(
+                    fn, prevent_cse=barrier,
+                    policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "conv_out")
+            return jax.checkpoint(fn, prevent_cse=barrier, policy=policy)
+
+        loss_fn = _wrap_loss_fn()
+
         def scan_step(params, opt_state, net_state, grad_acc,
                       data, labels, mask, extra, hyper_row, do_up,
                       step, base_key, collect):
@@ -291,9 +357,9 @@ class NetTrainer:
             streams on long runs."""
             rng = jax.random.fold_in(base_key, step)
             (loss, (new_state, preds)), grads = jax.value_and_grad(
-                net.loss_fn, has_aux=True)(
+                loss_fn, has_aux=True)(
                     _grad_cast(params), net_state, data, labels, mask,
-                    extra=extra, rng=rng, collect_nodes=metric_nodes)
+                    extra, rng)
             preds = [p.astype(jnp.float32) for p in preds] if collect \
                 else []
             if update_period == 1:
@@ -673,7 +739,10 @@ class NetTrainer:
         self._metrics.clear()
         nodes_wanted = tuple(self._metric_nodes)
         from ..parallel import synced_batches
-        for batch in synced_batches(data_iter, window=8):
+        # same lockstep window as the CLI train loop (dispatch_period),
+        # not a private constant — multi-process ranks must agree on it
+        for batch in synced_batches(data_iter,
+                                    window=self.dispatch_period):
             # same input path as training: uint8 pixels ship raw (1/4
             # the H2D bytes) and pre-placed prefetch batches pass
             # through (reference evaluates through the training pipeline,
@@ -820,9 +889,14 @@ class NetTrainer:
                 if isinstance(v, jax.Array) and \
                         not v.is_fully_addressable:
                     from jax.experimental import multihost_utils
-                    return np.asarray(multihost_utils.process_allgather(
-                        v, tiled=True))
-                return np.asarray(v)
+                    v = multihost_utils.process_allgather(v, tiled=True)
+                a = np.asarray(v)
+                # npz can't represent bfloat16 (stored as opaque V2 and
+                # unreadable on load); momentum_dtype=bfloat16 buffers
+                # ship as f32 (exact) and load_model casts back per the
+                # resuming config
+                return a.astype(np.float32) if a.dtype == jnp.bfloat16 \
+                    else a
             for lk, tags in self.opt_state.items():
                 for tag, st in tags.items():
                     for k, v in st.items():
@@ -879,7 +953,11 @@ class NetTrainer:
                     for k in st:
                         key = "opt/%s/%s/%s" % (lk, tag, k)
                         if key in blob:
-                            new[k] = jnp.asarray(blob[key])
+                            # cast to the dtype the CURRENT config
+                            # initialized (snapshots store f32; the
+                            # momentum_dtype of the resuming run wins)
+                            new[k] = jnp.asarray(blob[key],
+                                                 dtype=st[k].dtype)
                     self.opt_state[lk][tag] = new
             self.opt_state = jax.device_put(self.opt_state,
                                             self._o_shard)

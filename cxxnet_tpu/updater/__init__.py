@@ -38,6 +38,22 @@ def _clip_nan(g: jnp.ndarray, bound: float) -> jnp.ndarray:
     return jnp.clip(g, -bound, bound)
 
 
+def _momentum_zeros(w: jnp.ndarray, param: UpdaterParam) -> jnp.ndarray:
+    """Momentum buffer in the configured storage dtype.
+
+    ``momentum_dtype = bfloat16`` halves the read+write HBM bytes of the
+    momentum term — the dominant optimizer-state traffic on big FC
+    layers (doc/perf_profile.md: kaiming's 52M-param fc1 update is
+    HBM-bound). The update arithmetic stays f32 (the buffer is upcast,
+    combined, then rounded back), so only storage rounding (~3 mantissa
+    bits) differs; the bf16 MNIST conv gate covers convergence.
+    """
+    if (param.momentum_dtype == "bfloat16"
+            and w.dtype == jnp.float32):
+        return jnp.zeros(w.shape, jnp.bfloat16)
+    return jnp.zeros_like(w)
+
+
 class SGDUpdater:
     name = "sgd"
 
@@ -45,15 +61,15 @@ class SGDUpdater:
         self.param = param
 
     def init_state(self, w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
-        return {"m_w": jnp.zeros_like(w)}
+        return {"m_w": _momentum_zeros(w, self.param)}
 
     def apply(self, w, g, state, hyper):
         p = self.param
         if p.clip_gradient != 0.0:
             g = _clip_nan(g, p.clip_gradient)
-        m_w = state["m_w"] * hyper["momentum"] \
+        m_w = state["m_w"].astype(w.dtype) * hyper["momentum"] \
             - hyper["learning_rate"] * (g + hyper["wd"] * w)
-        return w + m_w, {"m_w": m_w}
+        return w + m_w, {"m_w": m_w.astype(state["m_w"].dtype)}
 
 
 class NAGUpdater:
@@ -63,17 +79,17 @@ class NAGUpdater:
         self.param = param
 
     def init_state(self, w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
-        return {"m_w": jnp.zeros_like(w)}
+        return {"m_w": _momentum_zeros(w, self.param)}
 
     def apply(self, w, g, state, hyper):
         p = self.param
         if p.clip_gradient != 0.0:
             g = _clip_nan(g, p.clip_gradient)
-        old = state["m_w"]
+        old = state["m_w"].astype(w.dtype)
         m_w = old * hyper["momentum"] \
             - hyper["learning_rate"] * (g + hyper["wd"] * w)
         w = w + (1.0 + hyper["momentum"]) * m_w - hyper["momentum"] * old
-        return w, {"m_w": m_w}
+        return w, {"m_w": m_w.astype(state["m_w"].dtype)}
 
 
 class AdamUpdater:

@@ -46,6 +46,11 @@ class UpdaterParam:
     # adam extras (adam_updater-inl.hpp:24-26: decay = 1 - beta)
     decay1: float = 0.1
     decay2: float = 0.001
+    # storage dtype of the sgd/nag momentum buffer: bfloat16 halves the
+    # optimizer-state HBM traffic of momentum-dominated updates (the
+    # update math stays f32; adam's second moment is range-sensitive
+    # and stays f32 regardless)
+    momentum_dtype: str = "float32"
 
     def schedule_epoch(self, epoch: int) -> None:
         if self.lr_schedule == 0:
@@ -89,6 +94,11 @@ class UpdaterParam:
             self.momentum_schedule = int(val)
         if name == "clip_gradient":
             self.clip_gradient = float(val)
+        if name == "momentum_dtype":
+            if val not in ("float32", "bfloat16"):
+                raise ValueError(
+                    "momentum_dtype must be float32 or bfloat16")
+            self.momentum_dtype = val
         if name == "final_momentum":
             self.final_momentum = float(val)
         if name == "base_momentum":

@@ -1,34 +1,49 @@
-"""Accuracy gate for the BN/concat topology class (VERDICT r3 §3).
+"""Accuracy gate for the BN/concat topology class (VERDICT r3 §3, held
+out per VERDICT r4 missing §2).
 
 The reference's headline accuracy claims live on Inception-BN
 (/root/reference/example/ImageNet/Inception-BN.conf:13-15, rec@1
-0.70454); MNIST gates only cover plain conv stacks. This gate trains
+0.70454) — an accuracy-on-held-out-data claim. This gate trains
 ``inception_bn_tiny`` — the same topology class: conv+batch_norm+relu
 stem, multi-branch ch_concat modules (avg-pool projection branch,
 stride-2 reduction), global-avg-pool head — on a synthetic 8-class
-memorization task through the REAL CLI (raw-tensor recordio archive →
-imgrec iterator → train → eval), asserting
+task through the REAL CLI (raw-tensor recordio archive → imgrec
+iterator → train → eval) and asserts accuracy on a DISJOINT archive
+drawn from the same distribution, so it proves learning that
+transfers, not memorization + running-stats agreement.
 
-- near-zero train error (the BN/concat graph actually learns), and
-- eval-with-running-stats agreement (the eval pass uses
-  ``running_exp/running_var``, so divergence between train-mode and
-  running-stats inference fails the gate).
+Threshold calibration (r5, the gate-margin rule from
+test_mnist_e2e.py): across 5 training seeds the held-out error
+measured 0.000 on ALL five; the bar is 0.10 — far beyond the
+±1-batch quantization of the 128-row eval set. The factor-10 LR
+decay at update 48 is load-bearing: without it, seed 3 plateaued at
+train 0.109 / held-out 0.375 (the same convergence-flake class the
+MNIST gates hit in r4, fixed the same way). The negative control
+(random train labels — chosen over frozen convs because this
+class-by-channel-pattern task is linearly separable from raw pixels,
+so a frozen backbone could pass) measured held-out error 1.000,
+proving the held-out eval catches
+memorization-without-generalization.
 """
 
-import os
 import re
 
 import numpy as np
-import pytest
 
 from cxxnet_tpu.io.recordio import RecordIOWriter, pack_raw_tensor_record
 from cxxnet_tpu.main import main
 
+HELD_OUT_BAR = 0.10
+
 
 def _make_archive(path: str, n: int = 256, size: int = 64,
-                  nclass: int = 8, seed: int = 0) -> None:
+                  nclass: int = 8, seed: int = 0,
+                  random_labels: bool = False) -> None:
     """Class-separable synthetic images: per-class channel pattern +
-    noise, uint8 raw-tensor records (no jpeg round trip)."""
+    noise, uint8 raw-tensor records (no jpeg round trip). The class
+    pattern is seed-independent, so archives with different seeds are
+    disjoint draws from the SAME distribution. random_labels breaks
+    the image->label dependence (negative-control archives)."""
     rng = np.random.RandomState(seed)
     w = RecordIOWriter(path, force_python=True)
     for i in range(n):
@@ -38,13 +53,20 @@ def _make_archive(path: str, n: int = 256, size: int = 64,
                          16 + 24 * ((k + 3) % nclass)], np.float32)
         img = base + rng.randn(size, size, 3) * 12.0
         img = np.clip(img, 0, 255).astype(np.uint8)
-        w.write_record(pack_raw_tensor_record(i, float(k), img))
+        lab = rng.randint(0, nclass) if random_labels else k
+        w.write_record(pack_raw_tensor_record(i, float(lab), img))
     w.close()
 
 
-def test_inception_bn_concat_accuracy_gate(tmp_path, monkeypatch):
-    rec = str(tmp_path / "synth.rec")
-    _make_archive(rec)
+def run_gate(tmp_path, monkeypatch, train_seed=0,
+             random_labels=False, num_round=9):
+    """Train on one archive, evaluate on a disjoint one; returns
+    (first_train_err, final_train_err, final_held_out_err)."""
+    rec_tr = str(tmp_path / ("train_s%d.rec" % train_seed))
+    rec_te = str(tmp_path / "heldout.rec")
+    _make_archive(rec_tr, n=256, seed=train_seed,
+                  random_labels=random_labels)
+    _make_archive(rec_te, n=128, seed=777)
 
     from cxxnet_tpu.models import inception_bn_tiny
     conf = """
@@ -62,35 +84,61 @@ iter = imgrec
 iter = end
 
 %s
-num_round = 7
+lr:schedule = factor
+lr:step = 48
+lr:factor = 0.1
+num_round = %d
 print_step = 0
+seed = %d
 model_dir = %s
-""" % (rec, rec, inception_bn_tiny(nclass=8, batch_size=32,
-                                   image_size=64, lr=0.1),
-       tmp_path / "models")
-    cp = tmp_path / "gate.conf"
+""" % (rec_tr, rec_te, inception_bn_tiny(nclass=8, batch_size=32,
+                                         image_size=64, lr=0.1),
+       num_round, train_seed, tmp_path / ("models_s%d" % train_seed))
+    cp = tmp_path / ("gate_s%d.conf" % train_seed)
     cp.write_text(conf)
 
     logs = []
     monkeypatch.setattr(
         "builtins.print", lambda *a, **k: logs.append(" ".join(map(str, a))))
     main([str(cp)])
+    monkeypatch.undo()
     txt = "\n".join(logs)
-
     rounds = re.findall(
         r"\[(\d+)\]\ttrain-error:([\d.]+)\ttest-error:([\d.]+)", txt)
     assert rounds, "no train/eval metric lines in CLI output:\n" + txt
-    first_train = float(rounds[0][1])
-    last_round, train_err, test_err = rounds[-1]
-    train_err, test_err = float(train_err), float(test_err)
-    # test-error is the full-dataset eval of the FINAL weights with
-    # running-stats batch_norm (train-error is measured online while
-    # weights move, so it lags): near-zero here proves BOTH that the
-    # BN/concat graph memorized the task and that running-stats
+    return (float(rounds[0][1]), float(rounds[-1][1]),
+            float(rounds[-1][2]), txt)
+
+
+def test_inception_bn_concat_heldout_gate(tmp_path, monkeypatch):
+    first_train, train_err, test_err, txt = run_gate(tmp_path,
+                                                     monkeypatch)
+    # held-out error of the FINAL weights under running-stats
+    # batch_norm: proves the BN/concat graph learned the class
+    # structure (not the training rows), and that running-stats
     # inference agrees with what training learned
-    assert test_err <= 0.05, \
-        "BN/concat net failed the memorization gate: test-error %.3f " \
+    assert test_err <= HELD_OUT_BAR, \
+        "BN/concat net failed the held-out gate: test-error %.3f " \
         "(train %.3f)\n%s" % (test_err, train_err, txt)
     assert train_err <= 0.1 and train_err < first_train * 0.5, \
         "train error did not converge: %.3f -> %.3f\n%s" % (
             first_train, train_err, txt)
+
+
+def test_inception_gate_negative_control(tmp_path, monkeypatch):
+    """Random train labels: the net can only memorize, so held-out
+    error must stay at chance and the gate condition must FAIL — the
+    teeth of the held-out split (the r4 gate, eval==train, could not
+    see this failure mode)."""
+    _, train_err, test_err, txt = run_gate(tmp_path, monkeypatch,
+                                           train_seed=3,
+                                           random_labels=True,
+                                           num_round=4)
+    assert test_err > HELD_OUT_BAR, \
+        "held-out gate has no teeth: random-label training scored " \
+        "test-error %.3f (train %.3f)\n%s" % (test_err, train_err, txt)
+    # chance for 8 classes is 0.875; anything near it confirms no
+    # image->label signal leaked into the held-out archive
+    assert test_err > 0.6, \
+        "random-label held-out error suspiciously low: %.3f\n%s" \
+        % (test_err, txt)

@@ -61,11 +61,20 @@ def test_mex_dispatch_executes(tmp_path):
             f.write(",".join([str(i % 4)] +
                              ["%.8f" % ((i * 10 + j) / 320.0)
                               for j in range(10)]) + "\n")
+    # image-shaped rows (1,6,6) for the example_conv.m flow
+    csv_conv = tmp_path / "train_conv.csv"
+    with open(csv_conv, "w") as f:
+        for i in range(32):
+            f.write(",".join([str(i % 4)] +
+                             ["%.8f" % (((i + j) % 36) / 36.0)
+                              for j in range(36)]) + "\n")
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"        # fast compile in the subprocess
     out = subprocess.run(
         [os.path.join(REPO, "bin", "mex_driver"), str(csv),
-         str(tmp_path / "m.model")],
+         str(tmp_path / "m.model"), str(csv_conv),
+         str(tmp_path / "mc.model")],
         capture_output=True, text=True, timeout=600, env=env)
     assert out.returncode == 0, (out.stdout, out.stderr)
-    assert "MEX-DRIVER-OK" in out.stdout
+    assert "MEX-DRIVER-OK" in out.stdout      # example.m flow
+    assert "MEX-CONV-OK" in out.stdout        # example_conv.m flow

@@ -613,3 +613,29 @@ def test_update_many_matches_updates_with_dropout():
                                rtol=1e-6, atol=1e-7,
                                err_msg="dropout masks differ between "
                                        "scanned and per-batch dispatch")
+
+
+@pytest.mark.parametrize("mode", ["full", "dots", "conv"])
+def test_remat_policies_match_baseline(mode):
+    """remat=full|dots|conv recompute activations in the backward pass
+    but must not change the math: params after several updates (incl.
+    the scanned run_steps dispatch, where the checkpoint sits inside
+    lax.scan) agree with remat=none to float rounding."""
+    rng = np.random.RandomState(5)
+    data, label = _bn_batch(rng)
+    t0 = make_trainer(BN_CONV_CONF)
+    t1 = make_trainer(BN_CONV_CONF, extra=[("remat", mode)])
+    for _ in range(2):
+        t0.update(DataBatch(data=data, label=label))
+        t1.update(DataBatch(data=data, label=label))
+    b = DataBatch(data=t0._put_batch_array(data),
+                  label=t0._put_batch_array(label))
+    t0.run_steps(b, 3)
+    t1.run_steps(b, 3)
+    np.testing.assert_allclose(np.asarray(t1.params["cv1"]["wmat"]),
+                               np.asarray(t0.params["cv1"]["wmat"]),
+                               rtol=2e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(t1.params["fc1"]["wmat"]),
+                               np.asarray(t0.params["fc1"]["wmat"]),
+                               rtol=2e-5, atol=1e-7)
+    np.testing.assert_allclose(t1.last_loss, t0.last_loss, rtol=1e-5)

@@ -138,3 +138,31 @@ def test_momentum_schedule():
     np.testing.assert_allclose(p.momentum, 0.7)
     p.schedule_epoch(100)
     np.testing.assert_allclose(p.momentum, 0.9)
+
+
+@pytest.mark.parametrize("opt", ["sgd", "nag"])
+def test_momentum_dtype_bf16_tracks_f32(opt):
+    """momentum_dtype=bfloat16 stores the buffer in bf16 (half the
+    optimizer-state HBM bytes) but must track the f32 updater to bf16
+    rounding over a multi-step trajectory."""
+    cfg = [("eta", "0.05"), ("momentum", "0.9"), ("wd", "0.001")]
+    u32 = create_updater(opt, "wmat", cfg)
+    u16 = create_updater(opt, "wmat", cfg + [("momentum_dtype",
+                                              "bfloat16")])
+    rng = np.random.RandomState(0)
+    w32 = w16 = jnp.asarray(rng.randn(64).astype(np.float32))
+    s32, s16 = u32.init_state(w32), u16.init_state(w16)
+    assert s16["m_w"].dtype == jnp.bfloat16
+    assert s32["m_w"].dtype == jnp.float32
+    for i in range(10):
+        g = jnp.asarray(rng.randn(64).astype(np.float32))
+        w32, s32 = u32.apply(w32, g, s32, _hyper(u32, i))
+        w16, s16 = u16.apply(w16, g, s16, _hyper(u16, i))
+        assert s16["m_w"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(w16), np.asarray(w32),
+                               rtol=0.02, atol=0.02)
+
+
+def test_momentum_dtype_rejects_unknown():
+    with pytest.raises(ValueError):
+        UpdaterParam(tag="wmat").set_param("momentum_dtype", "fp8")

@@ -52,10 +52,11 @@ static float *F(mxArray *a) {
   return static_cast<float *>(mxGetData(a));
 }
 
-int main(int argc, char **argv) {
-  CHECK(argc == 3);
-  const std::string csv = argv[1], model_path = argv[2];
-
+/* The flow of example.m (reference wrapper/matlab/example.m): train
+ * epochs via update-from-iter AND update-from-(data,label), evaluate,
+ * predict, weight get/set round-trip, extract, save/load. */
+static void RunMlpExample(const std::string &csv,
+                          const std::string &model_path) {
   const std::string iter_cfg =
       "iter = csv\n  filename = " + csv +
       "\n  input_shape = 1,1,10\n  label_width = 1\n"
@@ -171,5 +172,83 @@ int main(int argc, char **argv) {
   Call("MEXCXNIOFree", {it}, 0);
   std::printf("MEX-DRIVER-OK nbatch=%d first_pred=%d\n", nbatch,
               (int)F(p1)[0]);
+}
+
+/* The flow of example_conv.m: a conv+pool net over image-shaped input
+ * (col-major (n,c,h,w) batches through the same dispatch table),
+ * epochs, evaluate, conv-weight get, save/load. */
+static void RunConvExample(const std::string &csv,
+                           const std::string &model_path) {
+  const std::string iter_cfg =
+      "iter = csv\n  filename = " + csv +
+      "\n  input_shape = 1,6,6\n  label_width = 1\n"
+      "iter = end\nbatch_size = 8\n";
+  const char *net_cfg =
+      "netconfig = start\n"
+      "layer[0->1] = conv:cv1\n"
+      "  kernel_size = 3\n  pad = 1\n  nchannel = 4\n"
+      "  random_type = xavier\n"
+      "layer[1->2] = relu\n"
+      "layer[2->3] = max_pooling:pool1\n"
+      "  kernel_size = 2\n  stride = 2\n"
+      "layer[3->4] = flatten\n"
+      "layer[4->5] = fullc:fc1\n  nhidden = 4\n  init_sigma = 0.05\n"
+      "layer[5->5] = softmax\n"
+      "netconfig = end\n"
+      "input_shape = 1,6,6\nbatch_size = 8\n"
+      "eta = 0.1\nmetric = error\n";
+
+  mxArray *it = Call("MEXCXNIOCreateFromConfig",
+                     {mxCreateString(iter_cfg.c_str())});
+  CHECK(it != NULL);
+  mxArray *net = Call("MEXCXNNetCreate",
+                      {mxCreateString("tpu"), mxCreateString(net_cfg)});
+  CHECK(net != NULL);
+  Call("MEXCXNNetInitModel", {net}, 0);
+
+  /* getdata must come back 4-D col-major (n,c,h,w) = (8,1,6,6) */
+  Call("MEXCXNIOBeforeFirst", {it}, 0);
+  CHECK(mxGetScalar(Call("MEXCXNIONext", {it})) != 0.0);
+  mxArray *bd = Call("MEXCXNIOGetData", {it});
+  const mwSize *dd = mxGetDimensions(bd);
+  CHECK(dd[0] == 8 && dd[1] == 1 && dd[2] == 6 && dd[3] == 6);
+
+  for (int r = 0; r < 2; ++r) {
+    Call("MEXCXNNetStartRound", {net, mxCreateDoubleScalar(r)}, 0);
+    Call("MEXCXNIOBeforeFirst", {it}, 0);
+    while (mxGetScalar(Call("MEXCXNIONext", {it})) != 0.0)
+      Call("MEXCXNNetUpdateIter", {net, it}, 0);
+  }
+  mxArray *ev = Call("MEXCXNNetEvaluate",
+                     {net, it, mxCreateString("train")});
+  char *evs = mxArrayToString(ev);
+  CHECK(evs != NULL && std::strstr(evs, "train-error:") != NULL);
+
+  /* conv weight comes out (nchannel, in*k*k) like get_weight's dump */
+  mxArray *w = Call("MEXCXNNetGetWeight",
+                    {net, mxCreateString("cv1"), mxCreateString("wmat")});
+  CHECK(mxGetDimensions(w)[0] == 4 && mxGetDimensions(w)[1] == 9);
+
+  mxArray *p1 = Call("MEXCXNNetPredictBatch", {net, bd});
+  CHECK(mxGetDimensions(p1)[0] == 8);
+  Call("MEXCXNNetSaveModel", {net, mxCreateString(model_path.c_str())},
+       0);
+  mxArray *net2 = Call("MEXCXNNetCreate",
+                       {mxCreateString("tpu"), mxCreateString(net_cfg)});
+  Call("MEXCXNNetLoadModel",
+       {net2, mxCreateString(model_path.c_str())}, 0);
+  mxArray *p2 = Call("MEXCXNNetPredictBatch", {net2, bd});
+  for (int i = 0; i < 8; ++i) CHECK(F(p2)[i] == F(p1)[i]);
+
+  Call("MEXCXNNetFree", {net2}, 0);
+  Call("MEXCXNNetFree", {net}, 0);
+  Call("MEXCXNIOFree", {it}, 0);
+  std::printf("MEX-CONV-OK\n");
+}
+
+int main(int argc, char **argv) {
+  CHECK(argc == 3 || argc == 5);
+  RunMlpExample(argv[1], argv[2]);
+  if (argc == 5) RunConvExample(argv[3], argv[4]);
   return 0;
 }
