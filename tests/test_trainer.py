@@ -639,3 +639,20 @@ def test_remat_policies_match_baseline(mode):
                                np.asarray(t0.params["fc1"]["wmat"]),
                                rtol=2e-5, atol=1e-7)
     np.testing.assert_allclose(t1.last_loss, t0.last_loss, rtol=1e-5)
+
+
+def test_remat_rejects_unknown_policy():
+    with pytest.raises(ValueError):
+        make_trainer(BN_CONV_CONF, extra=[("remat", "segments")])
+
+
+def test_dispatch_period_reaches_trainer():
+    """main.py and the trainer parse dispatch_period independently from
+    the same config; the trainer's evaluate lockstep window must match
+    the CLI train loop's or multi-process ranks could disagree."""
+    t = make_trainer(MLP_CONF, extra=[("dispatch_period", "5")])
+    assert t.dispatch_period == 5
+    from cxxnet_tpu.main import LearnTask
+    task = LearnTask()
+    task._set("dispatch_period", "5")
+    assert task.dispatch_period == t.dispatch_period
