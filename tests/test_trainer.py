@@ -656,3 +656,65 @@ def test_dispatch_period_reaches_trainer():
     task = LearnTask()
     task._set("dispatch_period", "5")
     assert task.dispatch_period == t.dispatch_period
+
+
+def test_zero_sharding_with_bf16_momentum():
+    """Cross-feature: ZeRO-1 optimizer sharding x momentum_dtype=bf16.
+    The bf16 buffer must stay 'data'-sharded across updates and the
+    trajectory must track the replicated-f32 run to bf16 rounding."""
+    import jax.numpy as jnp
+
+    mesh = make_mesh(4, 1)
+    t = make_trainer(extra=[("shard_optimizer", "1"),
+                            ("momentum_dtype", "bfloat16"),
+                            ("batch_size", "48")], mesh=mesh)
+    t0 = make_trainer(extra=[("batch_size", "48")],
+                      mesh=make_mesh(4, 1))
+    m = t.opt_state["fc1"]["wmat"]["m_w"]
+    assert m.dtype == jnp.bfloat16
+    assert tuple(m.sharding.spec)[0] == "data", m.sharding
+
+    rng = np.random.RandomState(0)
+    data = rng.rand(48, 256).astype(np.float32)
+    label = rng.randint(0, 4, (48, 1)).astype(np.float32)
+    for _ in range(3):
+        t.update(DataBatch(data=data, label=label))
+        t0.update(DataBatch(data=data, label=label))
+    m = t.opt_state["fc1"]["wmat"]["m_w"]
+    assert m.dtype == jnp.bfloat16
+    assert tuple(m.sharding.spec)[0] == "data", m.sharding
+    np.testing.assert_allclose(np.asarray(t.params["fc1"]["wmat"]),
+                               np.asarray(t0.params["fc1"]["wmat"]),
+                               rtol=0.02, atol=2e-3)
+
+
+def test_bf16_momentum_snapshot_roundtrip(tmp_path):
+    """save_optimizer + momentum_dtype=bf16: npz stores momentum as
+    f32 (npz has no bf16), and the RESUMING config decides the restored
+    dtype — bf16 resume restores bf16 values exactly, f32 resume gets
+    the same (upcast-exact) state."""
+    import jax.numpy as jnp
+
+    bf16 = [("momentum_dtype", "bfloat16"), ("save_optimizer", "1")]
+    t = make_trainer(extra=bf16)
+    rng = np.random.RandomState(1)
+    data = rng.rand(50, 256).astype(np.float32)
+    label = rng.randint(0, 4, (50, 1)).astype(np.float32)
+    t.update(DataBatch(data=data, label=label))
+    path = str(tmp_path / "m.model.npz")
+    t.save_model(path)
+
+    t2 = make_trainer(extra=bf16)
+    t2.load_model(path)
+    m1 = t.opt_state["fc1"]["wmat"]["m_w"]
+    m2 = t2.opt_state["fc1"]["wmat"]["m_w"]
+    assert m2.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(m1, np.float32),
+                                  np.asarray(m2, np.float32))
+
+    t3 = make_trainer(extra=[("save_optimizer", "1")])  # f32 resume
+    t3.load_model(path)
+    m3 = t3.opt_state["fc1"]["wmat"]["m_w"]
+    assert m3.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(m1, np.float32),
+                                  np.asarray(m3))
