@@ -123,11 +123,16 @@ def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
     if batch is None:
         batch = default_batch
     builder = getattr(zoo, model)
+    # momentum_dtype=bfloat16: +1.9-2.6% measured (doc/perf_profile.md
+    # r5), convergence-gated by the bf16 MNIST conv gate — part of the
+    # TPU-idiomatic training configuration like dtype=bfloat16.
+    # grad_dtype stays f32 by default (negative single-chip, r3).
     t = NetTrainer(parse_config(builder(nclass=1000, batch_size=batch,
                                         image_size=size,
                                         **(builder_kw or {})))
                    + [("eval_train", "0"), ("dtype", dtype),
-                      ("grad_dtype", grad_dtype), ("silent", "1")]
+                      ("grad_dtype", grad_dtype),
+                      ("momentum_dtype", "bfloat16"), ("silent", "1")]
                    + list(extra))
     t.init_model()
 
