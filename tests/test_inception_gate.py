@@ -59,7 +59,7 @@ def _make_archive(path: str, n: int = 256, size: int = 64,
 
 
 def run_gate(tmp_path, monkeypatch, train_seed=0,
-             random_labels=False, num_round=9):
+             random_labels=False, num_round=9, extra_conf=""):
     """Train on one archive, evaluate on a disjoint one; returns
     (first_train_err, final_train_err, final_held_out_err)."""
     rec_tr = str(tmp_path / ("train_s%d.rec" % train_seed))
@@ -84,6 +84,7 @@ iter = imgrec
 iter = end
 
 %s
+%s
 lr:schedule = factor
 lr:step = 48
 lr:factor = 0.1
@@ -93,7 +94,8 @@ seed = %d
 model_dir = %s
 """ % (rec_tr, rec_te, inception_bn_tiny(nclass=8, batch_size=32,
                                          image_size=64, lr=0.1),
-       num_round, train_seed, tmp_path / ("models_s%d" % train_seed))
+       extra_conf, num_round, train_seed,
+       tmp_path / ("models_s%d" % train_seed))
     cp = tmp_path / ("gate_s%d.conf" % train_seed)
     cp.write_text(conf)
 
@@ -122,6 +124,27 @@ def test_inception_bn_concat_heldout_gate(tmp_path, monkeypatch):
         "(train %.3f)\n%s" % (test_err, train_err, txt)
     assert train_err <= 0.1 and train_err < first_train * 0.5, \
         "train error did not converge: %.3f -> %.3f\n%s" % (
+            first_train, train_err, txt)
+
+
+def test_inception_bn_heldout_gate_bf16(tmp_path, monkeypatch):
+    """The benchmark configuration (dtype=bfloat16 with the folded-BN
+    bf16 normalize, momentum_dtype=bfloat16) through the same held-out
+    gate: topology-scale accuracy coverage for the bf16 BN path the
+    advisor flagged (folded train-mode BN rounds in bf16 while eval
+    promotes to f32 — running-stats inference must still agree).
+    Calibration (r5): held-out 0.000 on seeds 0 and 3; the ONLINE
+    train metric can lag under bf16 (seed 0 finished at 0.137 while
+    its final weights scored 0.000 held-out), so this variant gates on
+    held-out error + convergence trend, not the final online value."""
+    first_train, train_err, test_err, txt = run_gate(
+        tmp_path, monkeypatch,
+        extra_conf="dtype = bfloat16\nmomentum_dtype = bfloat16")
+    assert test_err <= HELD_OUT_BAR, \
+        "bf16 BN/concat net failed the held-out gate: test-error " \
+        "%.3f (train %.3f)\n%s" % (test_err, train_err, txt)
+    assert train_err < first_train, \
+        "bf16 train error did not improve: %.3f -> %.3f\n%s" % (
             first_train, train_err, txt)
 
 
