@@ -79,7 +79,7 @@ bin/mex_driver: wrapper/matlab/mex_driver.cc \
 # `make check` is THE release gate: the FULL suite including the e2e
 # accuracy gates (MNIST MLP, two ~20min MNIST conv gates, BN/concat
 # inception held-out gates). Wall time per round is recorded in
-# README.md (r5: 58min on this 1-core host); `make check-fast`
+# README.md (r5: 62min, 236 tests, on this 1-core host); `make check-fast`
 # (~25min) skips only the MNIST e2e gates and is NOT sufficient for a
 # release.
 check: all
