@@ -116,6 +116,8 @@ def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
     import jax
     import cxxnet_tpu.models as zoo
     from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.monitor import MemorySink, Monitor
+    from cxxnet_tpu.monitor.schema import validate_records
     from cxxnet_tpu.nnet.trainer import NetTrainer
     from cxxnet_tpu.utils.config import parse_config
 
@@ -143,14 +145,22 @@ def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
         label=t._put_batch_array(
             rng.randint(0, 1000, (batch, 1)).astype(np.float32)))
 
+    # throughput comes from the telemetry stream, not a re-derived
+    # timer: the monitored trainer times each run_steps dispatch
+    # (blocking on the final loss, the same sync `_ = t.last_loss`
+    # forced before), and every record is schema-validated — so the
+    # BENCH_r*.json fields and a training run's monitor.jsonl report
+    # through one code path (doc/observability.md)
+    sink = MemorySink()
+    t.set_monitor(Monitor(sink))
     t.run_steps(b, steps)                   # compile + warmup (same n)
-    _ = t.last_loss                         # host sync
 
     def window():
-        start = time.perf_counter()
+        sink.clear()
         t.run_steps(b, steps)
-        _ = t.last_loss                     # host sync on final step
-        return time.perf_counter() - start
+        validate_records(sink.records)
+        (rec,) = [r for r in sink.records if r["event"] == "step"]
+        return rec["wall_ms"] / 1e3
 
     best, dts, suspect = capture(window)
     n_chips = max(len(jax.devices()), 1)

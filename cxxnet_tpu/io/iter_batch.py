@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -139,6 +140,7 @@ class PrefetchIterator(IIterator):
         self._lock = threading.Lock()
         self._epoch = 0                 # consumer's target epoch
         self._transform = None          # e.g. device_put in-thread
+        self.wait_hist = None           # monitor LatencyHistogram
 
     def set_param(self, name: str, val: str) -> None:
         self.base.set_param(name, val)
@@ -150,6 +152,17 @@ class PrefetchIterator(IIterator):
         overlap host->device transfer (jax.device_put) with device
         compute, the TPU analogue of the reference's copy overlap."""
         self._transform = fn
+
+    def enable_wait_stats(self):
+        """Attach a latency histogram over consumer-side batch-fetch
+        waits (time blocked on the prefetch queue — the direct measure
+        of 'is the input pipeline keeping up'). Only attached when the
+        monitor is active, so the unmonitored path never pays the
+        per-batch clock reads. Returns the histogram; the caller
+        snapshots/resets it at round boundaries."""
+        from ..monitor import LatencyHistogram
+        self.wait_hist = LatencyHistogram()
+        return self.wait_hist
 
     def init(self) -> None:
         self.base.init()
@@ -201,13 +214,19 @@ class PrefetchIterator(IIterator):
         self._restart.set()
 
     def next(self) -> bool:
+        t0 = time.perf_counter() if self.wait_hist is not None else 0.0
         while True:
             epoch, item = self._q.get()
             with self._lock:
                 if epoch != self._epoch:
                     continue            # stale batch from a prior epoch
             if item is None:
+                # end-of-epoch sentinel: not a batch fetch — recording
+                # its wait would add one spurious (and often dominant)
+                # observation per round
                 return False
+            if self.wait_hist is not None:
+                self.wait_hist.observe(time.perf_counter() - t0)
             self._out = item
             return True
 

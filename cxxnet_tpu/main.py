@@ -22,6 +22,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .io import create_iterator
+from .monitor import (Monitor, create_monitor, device_memory_snapshot,
+                      run_metadata, set_global)
 from .nnet.trainer import NetTrainer
 from .parallel import (init_distributed, is_root, synced_batches,
                        world_size)
@@ -58,6 +60,11 @@ class LearnTask:
         # amortizes host dispatch latency; schedule stays per-update
         # correct. 1 = per-batch update().
         self.dispatch_period = 8
+        # observability (doc/observability.md); a null monitor until
+        # run() builds the configured one, so task methods are safe to
+        # call directly in tests
+        self._mon = Monitor()
+        self._cfg_stream = []
 
     # -- config ----------------------------------------------------------
 
@@ -160,62 +167,71 @@ class LearnTask:
             if name == "pred":
                 self.name_pred = val
 
-        # model_in via filename convention infers start counter when
-        # continuing training (cxxnet_main.cpp:204-215); finetune starts
-        # a fresh model numbering
-        if self.model_in and self.task == "train":
-            m = _MODEL_RE.match(os.path.basename(self.model_in))
-            if m:
-                self.start_counter = int(m.group(1)) + 1
+        # structured telemetry (monitor = none|stdout|jsonl); non-root
+        # ranks get a null sink inside create_monitor. Installed as the
+        # process-global so deep call sites (metric fallback warnings)
+        # reach the same stream.
+        self._cfg_stream = cfg
+        self._mon = create_monitor(global_cfg)
+        set_global(self._mon)
 
-        if self.continue_training:
-            latest = self._sync_latest_model()
-            if latest is not None:
-                self.model_in = latest
-
-        # iterators (closed on exit: prefetch threads / decode pools)
-        itr_train = None
-        eval_iters: List[Tuple[str, object]] = []
-        pred_iter = None
+        # iterators (closed on exit: prefetch threads / decode pools);
+        # hoisted above the try so the finally can always iterate it
         all_iters: List[object] = []
-        batch_cfg = [(k, v) for k, v in global_cfg
-                     if k in ("batch_size", "input_shape", "label_width")]
-        # multi-process dp: config batch_size is GLOBAL (doc/global.md);
-        # each rank's iterator produces its 1/world_size local shard,
-        # which the trainer assembles into the global batch
-        # (make_array_from_process_local_data). Rank-disjoint DATA comes
-        # from the iterators' own part_index/num_parts sharding.
-        nproc = world_size()
-
-        def _local_bs(v: str) -> str:
-            assert int(v) % nproc == 0, \
-                "batch_size %s must divide evenly across %d " \
-                "processes" % (v, nproc)
-            return str(int(v) // nproc)
-
-        def _localize(pairs):
-            """Divide every batch_size by world_size — both the global
-            section AND iterator-block overrides (a block-level
-            batch_size applied after the divided global one would feed
-            world_size-times-too-many rows into the global assembly)."""
-            if nproc == 1:
-                return pairs
-            return [(k, _local_bs(v) if k == "batch_size" else v)
-                    for k, v in pairs]
-
-        batch_cfg = _localize(batch_cfg)
-        for b in blocks:
-            it = create_iterator(_localize(b["cfg"]), batch_cfg)
-            it.init()
-            all_iters.append(it)
-            if b["kind"] == "data":
-                itr_train = it
-            elif b["kind"] == "eval":
-                eval_iters.append((b["name"], it))
-            elif b["kind"] == "pred":
-                pred_iter = it
-
         try:
+            # model_in via filename convention infers start counter when
+            # continuing training (cxxnet_main.cpp:204-215); finetune starts
+            # a fresh model numbering
+            if self.model_in and self.task == "train":
+                m = _MODEL_RE.match(os.path.basename(self.model_in))
+                if m:
+                    self.start_counter = int(m.group(1)) + 1
+
+            if self.continue_training:
+                latest = self._sync_latest_model()
+                if latest is not None:
+                    self.model_in = latest
+
+            itr_train = None
+            eval_iters: List[Tuple[str, object]] = []
+            pred_iter = None
+            batch_cfg = [(k, v) for k, v in global_cfg
+                         if k in ("batch_size", "input_shape", "label_width")]
+            # multi-process dp: config batch_size is GLOBAL (doc/global.md);
+            # each rank's iterator produces its 1/world_size local shard,
+            # which the trainer assembles into the global batch
+            # (make_array_from_process_local_data). Rank-disjoint DATA comes
+            # from the iterators' own part_index/num_parts sharding.
+            nproc = world_size()
+
+            def _local_bs(v: str) -> str:
+                assert int(v) % nproc == 0, \
+                    "batch_size %s must divide evenly across %d " \
+                    "processes" % (v, nproc)
+                return str(int(v) // nproc)
+
+            def _localize(pairs):
+                """Divide every batch_size by world_size — both the global
+                section AND iterator-block overrides (a block-level
+                batch_size applied after the divided global one would feed
+                world_size-times-too-many rows into the global assembly)."""
+                if nproc == 1:
+                    return pairs
+                return [(k, _local_bs(v) if k == "batch_size" else v)
+                        for k, v in pairs]
+
+            batch_cfg = _localize(batch_cfg)
+            for b in blocks:
+                it = create_iterator(_localize(b["cfg"]), batch_cfg)
+                it.init()
+                all_iters.append(it)
+                if b["kind"] == "data":
+                    itr_train = it
+                elif b["kind"] == "eval":
+                    eval_iters.append((b["name"], it))
+                elif b["kind"] == "pred":
+                    pred_iter = it
+
             if self.test_io:
                 return self._task_test_io(itr_train)
 
@@ -249,64 +265,125 @@ class LearnTask:
             print("unknown task %r" % self.task)
             return 1
         finally:
-            for it in all_iters:
-                it.close()
+            # iterator construction and the task bodies share one
+            # cleanup scope: a config error must still close prefetch
+            # threads, release the jsonl sink, and clear the global
+            # monitor (a stale global would swallow later warn_once
+            # calls in long-lived library processes). The nested
+            # finally flushes the sink even when an iterator close
+            # raises (a wedged prefetch thread must not lose the
+            # buffered tail of the record stream).
+            try:
+                for it in all_iters:
+                    it.close()
+            finally:
+                set_global(None)
+                self._mon.close()
 
     def _task_test_io(self, itr) -> int:
         assert itr is not None, "test_io requires a data block"
+        mon = self._mon
+        if mon.enabled:
+            mon.emit("run_start",
+                     **run_metadata("test_io", self._cfg_stream))
         start = time.time()
         n = 0
         for r in range(self.num_round):
             for batch in itr:
                 n += batch.batch_size - batch.num_batch_padd
         dt = time.time() - start
-        print("test_io: %d instances in %.2fs (%.1f/sec)"
-              % (n, dt, n / max(dt, 1e-9)))
+        ips = n / max(dt, 1e-9)
+        mon.line("test_io: %d instances in %.2fs (%.1f/sec)"
+                 % (n, dt, ips))
+        if mon.enabled:
+            mon.emit("test_io", instances=n, wall_s=dt,
+                     instances_per_sec=ips)
         return 0
 
     def _task_train(self, trainer, itr_train, eval_iters) -> int:
         assert itr_train is not None, "train requires a data block"
+        mon = self._mon
+        trainer.set_monitor(mon)
         if hasattr(itr_train, "set_transform"):
             # threadbuffer chains overlap host->device transfer with
             # device compute by device_put-ing in the prefetch thread
             itr_train.set_transform(trainer.device_put_batch)
+        monitored = mon.enabled
+        io_hist = None
+        if monitored:
+            mon.emit("run_start", **run_metadata(
+                self.task, self._cfg_stream, trainer.mesh))
+            if hasattr(itr_train, "enable_wait_stats"):
+                # batch-fetch latency histogram on the prefetch chain;
+                # attached only under an active monitor so the default
+                # path never pays the per-batch clock reads
+                io_hist = itr_train.enable_wait_stats()
         start = time.time()
         k = self.dispatch_period
 
         def _progress(r, nbatch):
             if (self.print_step and nbatch % self.print_step < k
                     and self.silent == 0 and is_root()):
-                print("round %8d:[%8d] %ld sec elapsed"
-                      % (r, nbatch, int(time.time() - start)))
+                mon.line("round %8d:[%8d] %ld sec elapsed"
+                         % (r, nbatch, int(time.time() - start)))
 
         for r in range(self.start_counter - 1, self.num_round):
             trainer.start_round(r)
+            if monitored:
+                mon.emit("round_start", round=r)
+            # trace hooks are NOT gated on an enabled sink: a profiler
+            # trace is one config line (monitor_trace_dir) away even
+            # with monitor = none, as doc/debug_perf.md advertises
+            mon.maybe_start_trace(r)
             nbatch = 0
             window = []
+            t_wait = time.perf_counter() if monitored else 0.0
             # lockstep across ranks: unequal per-rank batch counts would
             # deadlock the SPMD collectives (see parallel.synced_batches)
             for batch in synced_batches(itr_train, window=k):
+                if monitored:
+                    # data-wait half of the step-time split: time this
+                    # loop spent blocked on the iterator since the last
+                    # dispatch
+                    trainer.note_data_wait(time.perf_counter() - t_wait)
                 if k == 1:
                     trainer.update(batch)
                     nbatch += 1
                 else:
                     window.append(batch)
                     if len(window) < k:
+                        if monitored:
+                            t_wait = time.perf_counter()
                         continue
                     trainer.update_many(window)
                     nbatch += len(window)
                     window = []
                 _progress(r, nbatch)
+                if monitored:
+                    t_wait = time.perf_counter()
             for batch in window:        # round tail: per-batch (a short
                 trainer.update(batch)   # window would recompile)
                 nbatch += 1
+            trainer.end_round()         # close the throughput window
+            #                             before evals start
             line = "[%d]" % (r + 1)
             if self.task_eval_train:
                 line += trainer.train_metric_str("train")
             for name, it in eval_iters:
                 line += trainer.evaluate(it, name)
             if self.silent == 0 and is_root():
-                print(line)
+                mon.line(line)
+            mon.maybe_stop_trace(r)
+            if monitored:
+                mon.emit("round_end", round=r,
+                         examples=trainer.last_round_examples,
+                         wall_s=trainer.last_round_wall_s,
+                         examples_per_sec=trainer
+                         .last_round_examples_per_sec)
+                mon.emit("memory", round=r, **device_memory_snapshot())
+                if io_hist is not None:
+                    mon.emit("io_wait", round=r, **io_hist.snapshot())
+                    io_hist.reset()
             if self.test_on_server:
                 # per-round weight consistency audit (the reference's
                 # test_on_server CheckWeight_, async_updater-inl.hpp:
@@ -318,8 +395,12 @@ class LearnTask:
                 # save_model writes on root only
                 trainer.save_model(self._model_path(r + 1))
         if self.silent == 0 and is_root():
-            print("updating end, %ld sec in all"
-                  % int(time.time() - start))
+            mon.line("updating end, %ld sec in all"
+                     % int(time.time() - start))
+        if monitored:
+            c = trainer.counters_snapshot()
+            mon.emit("run_end", wall_s=time.time() - start,
+                     steps=int(c["steps"]), examples=int(c["examples"]))
         return 0
 
     def _task_predict(self, trainer, itr) -> int:
@@ -330,17 +411,29 @@ class LearnTask:
         assert world_size() == 1, \
             "task=pred must run single-process (launch without " \
             "CXXNET_COORDINATOR)"
+        mon = self._mon
+        if mon.enabled:
+            mon.emit("run_start", **run_metadata(
+                "pred", self._cfg_stream, trainer.mesh))
+        nrow = 0
         with open_stream(self.name_pred, "w") as f:
             for batch in itr:
                 for v in trainer.predict(batch):
                     f.write("%g\n" % v)
-        print("finished prediction, write into %s" % self.name_pred)
+                    nrow += 1
+        mon.line("finished prediction, write into %s" % self.name_pred)
+        if mon.enabled:
+            mon.emit("task_end", task="pred", outfile=self.name_pred,
+                     rows=nrow)
         return 0
 
     def _task_extract(self, trainer, itr) -> int:
         assert itr is not None, "extract requires an iterator"
         assert world_size() == 1, \
             "task=extract_feature must run single-process"
+        if self._mon.enabled:
+            self._mon.emit("run_start", **run_metadata(
+                "extract", self._cfg_stream, trainer.mesh))
         node = self.extract_node_name
         txt = self.output_format == "txt"
         nrow, shape3 = 0, (0, 0, 0)
@@ -365,12 +458,18 @@ class LearnTask:
         # shape sidecar: "nrow,ch,y,x" (cxxnet_main.cpp:418)
         with open_stream(self.name_pred + ".meta", "w") as fm:
             fm.write("%d,%d,%d,%d\n" % ((nrow,) + tuple(shape3)))
-        print("finished feature extraction, write into %s"
-              % self.name_pred)
+        self._mon.line("finished feature extraction, write into %s"
+                       % self.name_pred)
+        if self._mon.enabled:
+            self._mon.emit("task_end", task="extract",
+                           outfile=self.name_pred, rows=nrow)
         return 0
 
     def _task_get_weight(self, trainer) -> int:
         assert self.weight_layer, "get_weight requires weight_layer"
+        if self._mon.enabled:
+            self._mon.emit("run_start", **run_metadata(
+                "get_weight", self._cfg_stream, trainer.mesh))
         w = trainer.get_weight(self.weight_layer, self.weight_tag)
         rows = w.reshape(w.shape[0], -1) if w.ndim > 1 else w[None, :]
         if self.output_format == "txt":
@@ -379,9 +478,12 @@ class LearnTask:
         else:                            # raw float32 (cxxnet_main:350)
             with open_stream(self.weight_filename, "wb") as f:
                 f.write(np.ascontiguousarray(rows, "<f4").tobytes())
-        print("weight %s:%s %s written to %s"
-              % (self.weight_layer, self.weight_tag, w.shape,
-                 self.weight_filename))
+        self._mon.line("weight %s:%s %s written to %s"
+                       % (self.weight_layer, self.weight_tag, w.shape,
+                          self.weight_filename))
+        if self._mon.enabled:
+            self._mon.emit("task_end", task="get_weight",
+                           outfile=self.weight_filename)
         return 0
 
 

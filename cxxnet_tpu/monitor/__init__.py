@@ -1,0 +1,435 @@
+"""Observability: structured per-step tracing and a metrics sink.
+
+The reference surfaced exactly two signals — the round eval line
+(metric.h printing format) and the ``round %8d:[%8d] %ld sec elapsed``
+progress print. This subsystem keeps those lines byte-identical (they
+are the *parity surface*) and adds a structured event stream beside
+them, configured through the same ``key = value`` config grammar:
+
+- ``monitor = none|stdout|jsonl`` — sink selection. ``none`` (default)
+  is a true no-op: no per-step host sync, no extra device transfers,
+  and stdout stays byte-identical to the unmonitored build.
+- ``monitor_path`` — JSONL output file for ``monitor = jsonl``
+  (default ``monitor.jsonl``; truncated per run, one JSON object per
+  line — one file is one run's stream).
+- ``monitor_flush_period`` — seconds between sink flushes (0 = flush
+  every record).
+- ``monitor_trace_dir`` — when set, a ``jax.profiler`` trace is
+  captured into this directory over a round window, so a perf trace is
+  one config line away.
+- ``monitor_trace_begin`` / ``monitor_trace_end`` — first/last round
+  (0-based) of the trace window; both default to round 1 (skipping the
+  compile-heavy round 0).
+
+Multi-process runs gate emission on process 0 (the rabit
+``IsRoot``-style gating main.py already applies to prints,
+cxxnet_main.cpp:424-435): non-root ranks get a null sink so one run
+produces one stream. Record vocabulary and validation live in
+``cxxnet_tpu.monitor.schema``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "Monitor", "NullSink", "StdoutSink", "JsonlSink", "MemorySink",
+    "LatencyHistogram", "create_monitor", "config_hash",
+    "device_memory_snapshot", "get_global", "set_global", "warn_once",
+]
+
+
+# -- sinks ---------------------------------------------------------------
+
+
+class NullSink:
+    """Drop everything. ``Monitor.enabled`` is False over this sink, so
+    callers skip record assembly entirely — the monitor = none fast
+    path costs one attribute check."""
+
+    enabled = False
+
+    def write(self, record: Dict[str, Any]) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class StdoutSink:
+    """Structured records as JSON lines on stdout, interleaved with the
+    parity text lines (which print unchanged — filtering lines that
+    start with ``{`` recovers the exact unmonitored output). ``log``
+    records are dropped: their text was already printed verbatim by
+    ``Monitor.line`` and echoing it as JSON would duplicate content."""
+
+    enabled = True
+
+    def write(self, record: Dict[str, Any]) -> None:
+        if record.get("event") == "log":
+            return
+        sys.stdout.write(json.dumps(record, sort_keys=True) + "\n")
+
+    def flush(self) -> None:
+        sys.stdout.flush()
+
+    def close(self) -> None:
+        self.flush()
+
+
+class JsonlSink:
+    """Write records to a JSONL file, flushing every
+    ``flush_period`` seconds (0 = every record). Buffering bounds the
+    per-step file-system cost; ``close()`` always drains. The file is
+    truncated per run — one file is one run's stream (re-running with
+    the same monitor_path must not interleave runs, and the schema's
+    monotonic-step check reads one run at a time); point monitor_path
+    at distinct files to keep history."""
+
+    enabled = True
+
+    def __init__(self, path: str, flush_period: float = 1.0):
+        self.path = path
+        self.flush_period = max(0.0, float(flush_period))
+        self._f = open(path, "w")
+        self._last_flush = time.monotonic()
+
+    def write(self, record: Dict[str, Any]) -> None:
+        self._f.write(json.dumps(record, sort_keys=True) + "\n")
+        now = time.monotonic()
+        if now - self._last_flush >= self.flush_period:
+            self._f.flush()
+            self._last_flush = now
+
+    def flush(self) -> None:
+        self._f.flush()
+        self._last_flush = time.monotonic()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+
+class MemorySink:
+    """In-process record list — the test/bench sink (bench.py reads
+    its throughput from these records instead of re-derived timers)."""
+
+    enabled = True
+
+    def __init__(self):
+        self.records: List[Dict[str, Any]] = []
+
+    def write(self, record: Dict[str, Any]) -> None:
+        self.records.append(record)
+
+    def clear(self) -> None:
+        self.records = []
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+# -- latency histogram ---------------------------------------------------
+
+
+class LatencyHistogram:
+    """Power-of-two millisecond buckets for host-side wait latencies
+    (batch fetch in the prefetch chain). observe() is two float ops and
+    an int increment — cheap enough for the per-batch path, and only
+    attached at all when monitoring is on."""
+
+    # bucket upper bounds in ms; last bucket is open-ended
+    BOUNDS = (0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+              256.0, 512.0, 1024.0)
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.counts = [0] * (len(self.BOUNDS) + 1)
+        self.n = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+
+    def observe(self, seconds: float) -> None:
+        ms = seconds * 1e3
+        self.n += 1
+        self.total_ms += ms
+        if ms > self.max_ms:
+            self.max_ms = ms
+        for i, b in enumerate(self.BOUNDS):
+            if ms <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        buckets = {}
+        for i, b in enumerate(self.BOUNDS):
+            if self.counts[i]:
+                buckets["<=%gms" % b] = self.counts[i]
+        if self.counts[-1]:
+            buckets[">%gms" % self.BOUNDS[-1]] = self.counts[-1]
+        mean = self.total_ms / self.n if self.n else 0.0
+        return {"count": self.n, "total_ms": round(self.total_ms, 3),
+                "mean_ms": round(mean, 3),
+                "max_ms": round(self.max_ms, 3), "buckets": buckets}
+
+
+# -- monitor -------------------------------------------------------------
+
+
+class Monitor:
+    """Event logger over one sink.
+
+    ``line(text)`` is the parity channel: the text prints to stdout
+    exactly as the unmonitored code did (callers keep their own
+    silent/is_root gating), and enabled sinks additionally record it as
+    a ``log`` event. ``emit(event, **fields)`` is the structured
+    channel; it is a no-op over a null sink.
+    """
+
+    def __init__(self, sink=None, trace_dir: str = "",
+                 trace_begin: int = 1, trace_end: Optional[int] = None):
+        self.sink = sink if sink is not None else NullSink()
+        self.trace_dir = trace_dir
+        self.trace_begin = trace_begin
+        self.trace_end = trace_begin if trace_end is None else trace_end
+        self._tracing = False
+        self._trace_started = False
+        self._trace_round = trace_begin
+        self._warned = set()
+
+    @property
+    def enabled(self) -> bool:
+        return self.sink.enabled
+
+    def emit(self, event: str, **fields: Any) -> None:
+        if not self.sink.enabled:
+            return
+        record = {"event": event, "t": time.time()}
+        record.update(fields)
+        self.sink.write(record)
+
+    def line(self, text: str) -> None:
+        """Print a parity stdout line; record it when enabled."""
+        print(text)
+        if self.sink.enabled:
+            self.emit("log", text=text)
+
+    def warn_once(self, code: str, message: str) -> None:
+        """Once-per-run structured warning; also surfaces on stderr so
+        a silent fallback (e.g. distributed metric reduction failing)
+        is visible even with monitor = none."""
+        if code in self._warned:
+            return
+        self._warned.add(code)
+        sys.stderr.write("[cxxnet_tpu monitor] warning %s: %s\n"
+                         % (code, message))
+        self.emit("warning", code=code, message=message)
+
+    # -- profiler trace window ------------------------------------------
+
+    def maybe_start_trace(self, round_idx: int) -> None:
+        """Start at the first observed round >= trace_begin (not only
+        on exact equality: a resumed run may begin past the window,
+        and a silent no-trace would be worse than a late one)."""
+        if (not self.trace_dir or self._tracing
+                or round_idx < self.trace_begin):
+            return
+        try:
+            import jax
+            jax.profiler.start_trace(self.trace_dir)
+        except Exception as e:  # profiler backend is best-effort
+            self.warn_once("trace_start_failed",
+                           "jax.profiler.start_trace failed: %s" % e)
+            return
+        self._tracing = True
+        self._trace_started = True
+        self._trace_round = round_idx
+        self.emit("trace_start", dir=self.trace_dir, round=round_idx)
+
+    def maybe_stop_trace(self, round_idx: int,
+                         force: bool = False) -> None:
+        if not self._tracing:
+            return
+        if not force and round_idx < self.trace_end:
+            self._trace_round = round_idx    # last round seen tracing
+            return
+        if force:
+            # close-time stop (run ended inside the window): attribute
+            # the stop to the last traced round, not the caller's 0
+            round_idx = max(round_idx, self._trace_round)
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as e:
+            # no trace was written: warn (and stop retrying), but do
+            # NOT emit trace_stop — the stream must not claim a trace
+            # that does not exist
+            self._tracing = False
+            self.warn_once("trace_stop_failed",
+                           "jax.profiler.stop_trace failed: %s" % e)
+            return
+        self._tracing = False
+        self.emit("trace_stop", dir=self.trace_dir, round=round_idx)
+
+    def close(self) -> None:
+        self.maybe_stop_trace(0, force=True)
+        if self.trace_dir and not self._trace_started:
+            # trace requested but the run never reached trace_begin —
+            # say so instead of leaving an empty dir with no diagnostic
+            self.warn_once(
+                "trace_never_started",
+                "monitor_trace_dir was set but no round >= "
+                "monitor_trace_begin (%d) ran; no trace captured"
+                % self.trace_begin)
+        self.sink.close()
+
+
+# -- construction --------------------------------------------------------
+
+
+def config_hash(cfg) -> str:
+    """Stable digest of the full ordered (name, value) config stream —
+    ties every record stream back to the exact run configuration."""
+    text = "\n".join("%s=%s" % (k, v) for k, v in cfg)
+    return hashlib.sha1(text.encode()).hexdigest()[:12]
+
+
+def create_monitor(cfg, root: Optional[bool] = None) -> Monitor:
+    """Build a Monitor from ``key = value`` config pairs.
+
+    Non-root processes always get a null sink (process-0 gating, the
+    same rule main.py applies to prints) — pass ``root`` explicitly to
+    override, e.g. in single-process library use before jax init.
+    """
+    mode = "none"
+    path = "monitor.jsonl"
+    flush_period = 1.0
+    trace_dir = ""
+    trace_begin, trace_end = 1, None
+    for name, val in cfg:
+        if name == "monitor":
+            if val not in ("none", "stdout", "jsonl"):
+                raise ValueError(
+                    "monitor must be none|stdout|jsonl, got %r" % val)
+            mode = val
+        if name == "monitor_path":
+            path = val
+        if name == "monitor_flush_period":
+            flush_period = float(val)
+        if name == "monitor_trace_dir":
+            trace_dir = val
+        if name == "monitor_trace_begin":
+            trace_begin = int(val)
+        if name == "monitor_trace_end":
+            trace_end = int(val)
+    if root is None:
+        from ..parallel import is_root
+        root = is_root()
+    if not root:
+        # process-0 gating: one run, one record stream, one trace —
+        # non-root ranks must not race on the trace dir or duplicate
+        # the close-time trace warnings
+        mode = "none"
+        trace_dir = ""
+    if mode == "stdout":
+        sink = StdoutSink()
+    elif mode == "jsonl":
+        sink = JsonlSink(path, flush_period)
+    else:
+        sink = NullSink()
+    return Monitor(sink, trace_dir=trace_dir, trace_begin=trace_begin,
+                   trace_end=trace_end)
+
+
+def run_metadata(task: str, cfg, mesh=None) -> Dict[str, Any]:
+    """Run-level metadata for the ``run_start`` record: mesh shape,
+    process topology, backend and versions, config digest."""
+    import platform as _platform
+
+    import jax
+    meta: Dict[str, Any] = {
+        "task": task,
+        "config_hash": config_hash(cfg),
+        "jax_version": jax.__version__,
+        "python_version": _platform.python_version(),
+        "platform": jax.default_backend(),
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "device_count": len(jax.devices()),
+        "device_kind": jax.devices()[0].device_kind,
+        "mesh": dict(mesh.shape) if mesh is not None else None,
+    }
+    return meta
+
+
+def device_memory_snapshot() -> Dict[str, Any]:
+    """Per-device memory stats where the backend provides them
+    (``Device.memory_stats()`` — TPU/GPU runtimes; CPU returns None).
+    Host-side query only: no device computation, safe at round
+    boundaries."""
+    import jax
+    devices = []
+    available = False
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            available = True
+            devices.append({
+                "id": d.id,
+                "kind": d.device_kind,
+                "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                "peak_bytes_in_use": int(
+                    stats.get("peak_bytes_in_use", 0)),
+                "bytes_limit": int(stats.get("bytes_limit", 0)),
+            })
+        else:
+            devices.append({"id": d.id, "kind": d.device_kind})
+    return {"available": available, "devices": devices}
+
+
+# -- global registry (the warn-once channel for deep call sites) ---------
+
+_global_monitor: Optional[Monitor] = None
+_fallback_warned: set = set()
+
+
+def set_global(mon: Optional[Monitor]) -> None:
+    """Install the run's monitor so deep call sites (utils/metric.py)
+    can reach it without threading it through every signature."""
+    global _global_monitor
+    _global_monitor = mon
+
+
+def get_global() -> Optional[Monitor]:
+    return _global_monitor
+
+
+def warn_once(code: str, message: str) -> None:
+    """Module-level warn-once: routes through the installed monitor, or
+    falls back to a bare once-per-process stderr line when no monitor
+    is active (library callers outside the CLI)."""
+    if _global_monitor is not None:
+        _global_monitor.warn_once(code, message)
+        return
+    if code in _fallback_warned:
+        return
+    _fallback_warned.add(code)
+    sys.stderr.write("[cxxnet_tpu monitor] warning %s: %s\n"
+                     % (code, message))

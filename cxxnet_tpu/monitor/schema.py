@@ -1,0 +1,118 @@
+"""Record vocabulary and validation for the monitor event stream.
+
+One place defines what each event must carry, so the smoke test, the
+bench capture, and any downstream consumer of ``BENCH_r*.json``
+throughput fields all check against the same contract. Validation is
+deliberately structural (required keys, value sanity) rather than a
+full JSON-Schema dependency: the container must not grow new packages.
+
+Cross-record invariants checked by :func:`validate_records`:
+
+- every record carries ``event`` (known type) and a float ``t``
+- all ``*_ms`` / ``*_s`` timings and ``examples_per_sec`` are
+  non-negative finite numbers
+- ``step`` records carry a strictly-increasing step counter and a
+  non-decreasing round
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Dict, Iterable, List
+
+# required payload keys per event type (beyond "event"/"t")
+REQUIRED: Dict[str, tuple] = {
+    "run_start": ("task", "config_hash", "jax_version", "platform",
+                  "process_count", "device_count", "mesh"),
+    "round_start": ("round",),
+    "step": ("step", "round", "dispatch", "n_batches", "examples",
+             "wall_ms", "data_wait_ms", "examples_per_sec",
+             "update_counter", "lr", "compile"),
+    "compile": ("kind", "wall_ms", "signature"),
+    "memory": ("round", "available", "devices"),
+    "io_wait": ("round", "count", "total_ms", "max_ms", "buckets"),
+    "eval": ("round", "name", "metrics"),
+    "round_end": ("round", "examples", "wall_s", "examples_per_sec"),
+    "trace_start": ("dir",),
+    "trace_stop": ("dir",),
+    "warning": ("code", "message"),
+    "log": ("text",),
+    "test_io": ("instances", "wall_s", "instances_per_sec"),
+    "task_end": ("task",),
+    "run_end": ("wall_s", "steps", "examples"),
+}
+
+_TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",
+                "mean_ms", "wall_s", "examples_per_sec",
+                "instances_per_sec")
+
+
+def validate_record(rec: Dict[str, Any]) -> List[str]:
+    """Structural check of one record; returns a list of problems."""
+    errs: List[str] = []
+    ev = rec.get("event")
+    if ev is None:
+        return ["record has no 'event' field: %r" % (rec,)]
+    if ev not in REQUIRED:
+        return ["unknown event type %r" % ev]
+    t = rec.get("t")
+    if not isinstance(t, (int, float)) or t <= 0:
+        errs.append("%s: bad timestamp %r" % (ev, t))
+    for key in REQUIRED[ev]:
+        if key not in rec:
+            errs.append("%s: missing required key %r" % (ev, key))
+    for key in _TIMING_KEYS:
+        if key in rec:
+            v = rec[key]
+            if (not isinstance(v, (int, float)) or v < 0
+                    or not math.isfinite(v)):
+                errs.append("%s: %s must be a non-negative finite "
+                            "number, got %r" % (ev, key, v))
+    return errs
+
+
+def validate_records(records: Iterable[Dict[str, Any]],
+                     strict: bool = True) -> List[str]:
+    """Validate a record stream, including cross-record invariants
+    (monotonic step counter, non-decreasing round). With ``strict``
+    (default) raises ValueError on the first batch of problems;
+    otherwise returns them."""
+    errs: List[str] = []
+    last_step = 0
+    last_round = None
+    for i, rec in enumerate(records):
+        for e in validate_record(rec):
+            errs.append("record %d: %s" % (i, e))
+        if rec.get("event") == "run_start":
+            # a new run's counters start over (concatenated streams)
+            last_step, last_round = 0, None
+        if rec.get("event") == "step":
+            step = rec.get("step")
+            if isinstance(step, int):
+                if step <= last_step:
+                    errs.append(
+                        "record %d: step counter not monotonic "
+                        "(%s after %s)" % (i, step, last_step))
+                last_step = step
+            rnd = rec.get("round")
+            if isinstance(rnd, int):
+                if last_round is not None and rnd < last_round:
+                    errs.append("record %d: round went backwards "
+                                "(%s after %s)" % (i, rnd, last_round))
+                last_round = rnd
+    if errs and strict:
+        raise ValueError("invalid monitor records:\n  "
+                         + "\n  ".join(errs))
+    return errs
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Load a monitor JSONL file (skipping blank lines)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -34,6 +34,7 @@ from __future__ import annotations
 import io
 import json
 import re
+import time
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -95,6 +96,21 @@ class NetTrainer:
         self.update_counter = 0          # applied updates (schedule epoch)
         self.round = 0
         self._initialized = False
+        # observability. Counters are always-on host ints (the wrapper
+        # progress-poll surface); everything time-based lives behind
+        # the monitor so monitor=none adds NO host<->device syncs to
+        # the step path.
+        self._mon = None                 # monitor.Monitor or None
+        self._steps_total = 0            # dispatches (telemetry step id)
+        self._examples_total = 0         # real (non-padded) local rows
+        self._round_examples = 0
+        self._round_t0 = None            # set by start_round
+        self.last_round_examples_per_sec = 0.0   # of the closed round
+        self._pending_data_wait = 0.0    # loop-measured iterator wait
+        self._seen_sigs = set()          # dispatch signatures (compile
+        #                                  / recompile detection)
+        self.last_round_examples = 0     # set by end_round
+        self.last_round_wall_s = 0.0
 
     # -- config ----------------------------------------------------------
 
@@ -618,13 +634,96 @@ class NetTrainer:
         lead = out.shape[:axis + 1]
         return out.reshape(lead + (-1,))
 
+    # -- observability ---------------------------------------------------
+
+    def set_monitor(self, mon) -> None:
+        """Attach a monitor (cxxnet_tpu.monitor.Monitor). With an
+        enabled sink, each dispatch is timed wall-clock INCLUDING a
+        block on the loss scalar — an honest device-step time at the
+        cost of losing dispatch/compute overlap (the observer effect;
+        documented in doc/observability.md). A None/disabled monitor
+        leaves the step path untouched."""
+        self._mon = mon
+
+    def _mon_on(self) -> bool:
+        return self._mon is not None and self._mon.enabled
+
+    def note_data_wait(self, seconds: float) -> None:
+        """The drive loop reports time it spent blocked on the data
+        iterator since the last dispatch; the next step record carries
+        it as data_wait_ms (the data-wait vs device-step split)."""
+        self._pending_data_wait += seconds
+
+    def _note_signature(self, kind: str, sig: tuple,
+                        wall: float) -> bool:
+        """First sighting of a dispatch signature means this wall time
+        included an XLA compile (first-step) or recompile (a shape /
+        static-arg change). Returns True when so, and emits the
+        compile record."""
+        key = (kind,) + sig
+        if key in self._seen_sigs:
+            return False
+        first = not self._seen_sigs
+        self._seen_sigs.add(key)
+        self._mon.emit("compile",
+                       kind="first" if first else "recompile",
+                       wall_ms=wall * 1e3, signature=repr(key))
+        return True
+
+    def _emit_step(self, kind: str, n_batches: int, examples: int,
+                   wall: float, sig: tuple, lr: float) -> None:
+        compiled = self._note_signature(kind, sig, wall)
+        wait, self._pending_data_wait = self._pending_data_wait, 0.0
+        self._mon.emit(
+            "step", step=self._steps_total, round=self.round,
+            dispatch=kind, n_batches=n_batches, examples=examples,
+            wall_ms=wall * 1e3, data_wait_ms=wait * 1e3,
+            examples_per_sec=examples / wall if wall > 0 else 0.0,
+            update_counter=self.update_counter, lr=lr,
+            compile=compiled)
+
+    def end_round(self) -> None:
+        """Close the current round's counter window (idempotent):
+        computes last_round_examples_per_sec for the wrapper poll
+        surface and the round_end record."""
+        if self._round_t0 is None:
+            return
+        dt = time.perf_counter() - self._round_t0
+        if dt > 0:
+            self.last_round_examples_per_sec = self._round_examples / dt
+        self.last_round_examples = self._round_examples
+        self.last_round_wall_s = dt
+        self._round_t0 = None
+
+    def counters_snapshot(self) -> Dict[str, float]:
+        """Cheap progress snapshot (no device sync): total dispatches,
+        total real examples consumed, and the throughput of the last
+        completed round — the wrapper/C-ABI polling surface."""
+        return {"steps": self._steps_total,
+                "examples": self._examples_total,
+                "last_round_examples_per_sec":
+                    self.last_round_examples_per_sec}
+
+    def _count_examples(self, examples: int) -> None:
+        """One dispatch = one step id, however many batches it fused;
+        ``examples`` counts the real (non-padded) LOCAL rows consumed
+        (per-process under multi-process dp — run_start carries
+        process_count for consumers that want global throughput)."""
+        self._steps_total += 1
+        self._examples_total += examples
+        self._round_examples += examples
+
     # -- public API ------------------------------------------------------
 
     def start_round(self, r: int) -> None:
+        self.end_round()                 # close the previous window
         self.round = r
+        self._round_t0 = time.perf_counter()
+        self._round_examples = 0
 
     def update(self, batch: DataBatch) -> None:
         assert self._initialized, "call init_model/load_model first"
+        t0 = time.perf_counter() if self._mon_on() else 0.0
         data, labels, mask, extra = self._device_batch(batch)
         hyper = self._hyper()
         # step BEFORE the counter bump: batch i of the run folds RNG
@@ -642,6 +741,15 @@ class NetTrainer:
         (self.params, self.opt_state, self.net_state,
          self.grad_acc, loss, preds) = out
         self._last_loss = loss
+        ex = self._local_batch_size(batch) - batch.num_batch_padd
+        self._count_examples(ex)
+        if self._mon_on():
+            jax.block_until_ready(loss)
+            wall = time.perf_counter() - t0
+            sig = (data.shape, str(data.dtype), labels.shape,
+                   mask is None, len(extra), bool(do_update))
+            self._emit_step("update", 1, ex, wall, sig,
+                            float(hyper[0, 0]) if len(hyper) else 0.0)
         if do_update:
             self.sample_counter = 0
             self.update_counter += 1
@@ -660,6 +768,7 @@ class NetTrainer:
         (reference applies ScheduleEpoch every update, updater/param.h:
         96-117)."""
         assert self._initialized and self.update_period == 1
+        t0 = time.perf_counter() if self._mon_on() else 0.0
         data, labels, mask, extra = self._device_batch(batch)
         hyper_k = np.stack([self._hyper(self.update_counter + i)
                             for i in range(int(n_steps))])
@@ -669,6 +778,17 @@ class NetTrainer:
                                self._step_scalar(), self._base_key)
         (self.params, self.opt_state, self.net_state, loss) = out
         self._last_loss = loss
+        n = int(n_steps)
+        ex = (self._local_batch_size(batch) - batch.num_batch_padd) * n
+        self._count_examples(ex)
+        if self._mon_on():
+            jax.block_until_ready(loss)
+            wall = time.perf_counter() - t0
+            sig = (data.shape, str(data.dtype), labels.shape,
+                   mask is None, len(extra), n)
+            self._emit_step("run_steps", n, ex, wall, sig,
+                            float(hyper_k[0, 0, 0]) if hyper_k.size
+                            else 0.0)
         self.update_counter += n_steps
 
     def update_many(self, batches: Sequence[DataBatch]) -> None:
@@ -687,6 +807,7 @@ class NetTrainer:
         K = len(batches)
         if K == 1:
             return self.update(batches[0])
+        t0 = time.perf_counter() if self._mon_on() else 0.0
         period = self.update_period
         S, U = self.sample_counter, self.update_counter
         hyper_k = np.stack([self._hyper(U + (S + i) // period)
@@ -717,6 +838,17 @@ class NetTrainer:
         (self.params, self.opt_state, self.net_state, self.grad_acc,
          loss, preds_k) = out
         self._last_loss = loss
+        ex = sum(self._local_batch_size(b) - b.num_batch_padd
+                 for b in batches)
+        self._count_examples(ex)
+        if self._mon_on():
+            jax.block_until_ready(loss)
+            wall = time.perf_counter() - t0
+            sig = (data_k.shape, str(data_k.dtype), labels_k.shape,
+                   mask_k is None, n_extra, K, collect)
+            self._emit_step("update_many", K, ex, wall, sig,
+                            float(hyper_k[0, 0, 0]) if hyper_k.size
+                            else 0.0)
         self.update_counter = U + (S + K) // period
         self.sample_counter = (S + K) % period
         if collect:
@@ -728,9 +860,12 @@ class NetTrainer:
                     self._label_fields(self._host_label(b), nvalid))
 
     def train_metric_str(self, name: str = "train") -> str:
-        s = self._train_metrics.print_str(name)
+        res = self._train_metrics.results()
         self._train_metrics.clear()
-        return s
+        if self._mon_on() and res:
+            self._mon.emit("eval", round=self.round, name=name,
+                           metrics={t: float(v) for t, v in res})
+        return MetricSet.format_line(name, res)
 
     def evaluate(self, data_iter, name: str) -> str:
         """Run a full eval pass; returns '\\t<name>-<metric>:<value>'."""
@@ -757,7 +892,14 @@ class NetTrainer:
             self._metrics.add_eval(
                 pred_np, self._label_fields(self._host_label(batch),
                                             nvalid))
-        return self._metrics.print_str(name)
+        res = self._metrics.results()
+        if self._mon_on() and res:
+            # structured record beside the parity line; ONE reduction
+            # per metric serves both (results() is collective under
+            # multi-process runs)
+            self._mon.emit("eval", round=self.round, name=name,
+                           metrics={t: float(v) for t, v in res})
+        return MetricSet.format_line(name, res)
 
     def predict(self, batch: DataBatch) -> np.ndarray:
         """argmax class (or raw scalar) per row of the top node
@@ -806,7 +948,11 @@ class NetTrainer:
                     continue
                 groups = defaultdict(list)
                 for s in w.addressable_shards:
-                    groups[s.index].append(s)
+                    # slices are unhashable before py3.12; key on their
+                    # fields
+                    key = tuple((sl.start, sl.stop, sl.step)
+                                for sl in s.index)
+                    groups[key].append(s)
                 for shards in groups.values():
                     ref = np.asarray(shards[0].data)
                     for s in shards[1:]:

@@ -38,9 +38,22 @@ def force_virtual_cpu(n_devices: int) -> None:
     (not env vars): this environment preloads jax at interpreter start,
     so JAX_PLATFORMS in os.environ is read too late, and config wins
     over a conflicting --xla_force_host_platform_device_count.
+
+    jax builds that predate the ``jax_num_cpu_devices`` option (< 0.5)
+    fall back to the XLA flag, which those builds DO read at backend
+    init even when jax was imported earlier.
     """
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_devices)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        # replace (not keep) any conflicting count — this function must
+        # win, same as the jax.config path above
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(
+            "--xla_force_host_platform_device_count=%d" % n_devices)
+        os.environ["XLA_FLAGS"] = " ".join(flags)
 
 
 def make_mesh(n_data: Optional[int] = None, n_model: int = 1,

@@ -47,15 +47,29 @@ class Metric:
 
 
 def _allreduce_sum_count(s: float, c: float) -> Tuple[float, float]:
-    """Sum (metric, count) across distributed processes, if any."""
+    """Sum (metric, count) across distributed processes, if any.
+
+    A reduction failure falls back to process-local values (the metric
+    line still prints, rabit-style), but VISIBLY: the bare
+    ``except Exception: pass`` that silently swallowed collective
+    failures is narrowed to the failure modes a degraded DCN/backend
+    actually produces, and the fallback emits a once-per-run structured
+    warning through the monitor. Anything else (a programming error)
+    propagates."""
     try:
         import jax
         if jax.process_count() > 1:
             from ..parallel import allreduce_host_sum
             out = allreduce_host_sum(np.array([s, c], np.float64))
             return float(out[0]), float(out[1])
-    except Exception:
-        pass
+    except (ImportError, RuntimeError, OSError) as e:
+        # JaxRuntimeError (collective timeout, coordination failure)
+        # subclasses RuntimeError; ImportError covers a jax-less host
+        from ..monitor import warn_once
+        warn_once("metric_allreduce_failed",
+                  "distributed metric reduction failed (%s: %s); "
+                  "reporting process-local metric values"
+                  % (type(e).__name__, e))
     return s, c
 
 
@@ -160,11 +174,27 @@ class MetricSet:
                 raise ValueError("Metric: unknown target = %s" % field)
             m.add_eval(pred, label_fields[field])
 
-    def print_str(self, evname: str) -> str:
+    def results(self) -> List[Tuple[str, float]]:
+        """[(tag, value)] where tag is ``<metric>[field]`` (field tag
+        only when non-default) — ONE reduction per metric, shared by
+        the parity line and the structured eval record (get() is a
+        cross-process collective under distributed runs; calling it
+        once per metric keeps ranks' collective counts in lockstep)."""
         out = []
         for m, field in zip(self.evals, self.label_fields):
-            tag = "%s-%s" % (evname, m.name)
-            if field != "label":
-                tag += "[%s]" % field
-            out.append("\t%s:%g" % (tag, m.get()))
-        return "".join(out)
+            tag = m.name if field == "label" \
+                else "%s[%s]" % (m.name, field)
+            out.append((tag, m.get()))
+        return out
+
+    @staticmethod
+    def format_line(evname: str,
+                    results: List[Tuple[str, float]]) -> str:
+        """THE parity eval-line format (reference metric.h printing) —
+        defined once; print_str and the trainer's train/eval lines all
+        render through here so the byte-exact surface cannot drift."""
+        return "".join("\t%s-%s:%g" % (evname, tag, v)
+                       for tag, v in results)
+
+    def print_str(self, evname: str) -> str:
+        return self.format_line(evname, self.results())

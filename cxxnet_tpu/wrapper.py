@@ -179,6 +179,15 @@ class Net:
         self._round = round_counter
         self._req().start_round(round_counter)
 
+    def counters(self) -> Dict[str, float]:
+        """Training-progress snapshot for polling callers (the C-ABI
+        parity surface): ``steps`` (jitted dispatches), ``examples``
+        (real rows consumed), ``last_round_examples_per_sec``
+        (throughput of the last completed ``start_round`` window).
+        Host-side ints only — safe to call from another thread at any
+        frequency without forcing a device sync."""
+        return self._req().counters_snapshot()
+
     # -- data plumbing ---------------------------------------------------
 
     def _to_batch(self, data, label=None) -> DataBatch:

@@ -1,0 +1,90 @@
+"""MetricSet edge cases previously uncovered: the unknown-field error
+path, rec@n tie-break determinism, and print_str formatting with a
+non-default label field (plus the results() twin that feeds the
+monitor's structured eval records)."""
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.utils.metric import (MetricRecall, MetricSet,
+                                     create_metric)
+
+
+def test_unknown_metric_name_raises():
+    ms = MetricSet()
+    with pytest.raises(ValueError, match="unknown metric name"):
+        ms.add_metric("no_such_metric")
+    assert create_metric("no_such_metric") is None
+
+
+def test_unknown_label_field_error_path():
+    """add_eval against a field the batch doesn't carry must fail with
+    the reference's "unknown target" wording, not silently skip."""
+    ms = MetricSet()
+    ms.add_metric("error", field="tags")
+    pred = np.array([[0.9, 0.1]], np.float32)
+    with pytest.raises(ValueError, match="unknown target = tags"):
+        ms.add_eval([pred], {"label": np.zeros((1, 1), np.float32)})
+    # the matching field works
+    ms.add_eval([pred], {"tags": np.zeros((1, 1), np.float32)})
+    assert ms.evals[0].cnt_inst == 1
+
+
+def test_rec_at_n_tie_break_determinism():
+    """Tied scores: the reference shuffled then stable-sorted (random
+    tie-break); here ties break by index — the SAME result on every
+    call, which the distributed eval path depends on (ranks must agree
+    on the metric value bit-for-bit before the allreduce)."""
+    m = MetricRecall("rec@2")
+    # row 0: all four scores tied; row 1: clear top-2
+    pred = np.array([[0.5, 0.5, 0.5, 0.5],
+                     [0.1, 0.9, 0.8, 0.0]], np.float32)
+    label = np.array([[0.0], [2.0]], np.float32)
+    first = m._calc(pred, label)
+    for _ in range(5):
+        np.testing.assert_array_equal(m._calc(pred, label), first)
+    # the deterministic tie-break picks low indices first, so label 0
+    # in the all-tied row is recalled; row 1's label 2 is in {1, 2}
+    np.testing.assert_array_equal(first, [1.0, 1.0])
+    # accumulated value is reproducible too
+    m.add_eval(pred, label)
+    v1 = m.get()
+    m.clear()
+    m.add_eval(pred, label)
+    assert m.get() == v1 == 1.0
+
+
+def test_rec_at_n_validates_width():
+    m = MetricRecall("rec@5")
+    with pytest.raises(ValueError, match="rec@5 on a list of 3"):
+        m._calc(np.zeros((2, 3), np.float32),
+                np.zeros((2, 1), np.float32))
+    with pytest.raises(ValueError):
+        MetricRecall("recall")             # malformed name
+
+
+def test_print_str_non_default_label_field():
+    ms = MetricSet()
+    ms.add_metric("error", field="tags")
+    ms.add_metric("rmse")                  # default field: no suffix
+    pred_err = np.array([[0.9, 0.1], [0.1, 0.9]], np.float32)
+    pred_rmse = np.array([[0.5]], np.float32)
+    ms.add_eval([pred_err, pred_rmse],
+                {"tags": np.array([[0.0], [0.0]], np.float32),
+                 "label": np.array([[1.0]], np.float32)})
+    s = ms.print_str("myeval")
+    # non-default field carries the [field] tag; default does not
+    assert "\tmyeval-error[tags]:0.5" in s
+    assert "\tmyeval-rmse:0.25" in s
+    assert "rmse[" not in s
+    # results() carries the same tags/values the parity line prints
+    res = dict(ms.results())
+    assert res["error[tags]"] == pytest.approx(0.5)
+    assert res["rmse"] == pytest.approx(0.25)
+
+
+def test_add_eval_length_mismatch_asserts():
+    ms = MetricSet()
+    ms.add_metric("error")
+    with pytest.raises(AssertionError):
+        ms.add_eval([], {"label": np.zeros((1, 1), np.float32)})

@@ -131,6 +131,30 @@ def test_net_requires_init():
         net.predict(np.zeros((8, 1, 1, 10), np.float32))
 
 
+def test_net_counters_snapshot():
+    """The C-ABI-parity progress-poll surface: steps / examples /
+    last-round throughput, maintained without any monitor attached."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(8, 1, 1, 10).astype(np.float32)
+    y = rng.randint(0, 4, (8,)).astype(np.float32)
+    net = Net(cfg=NET_CFG)
+    with pytest.raises(RuntimeError):
+        net.counters()                     # needs an initialized model
+    net.init_model()
+    assert net.counters() == {"steps": 0, "examples": 0,
+                              "last_round_examples_per_sec": 0.0}
+    net.start_round(0)
+    for _ in range(3):
+        net.update(X, y)
+    c = net.counters()
+    assert c["steps"] == 3 and c["examples"] == 24
+    assert c["last_round_examples_per_sec"] == 0.0   # round still open
+    net.start_round(1)                     # closes round 0's window
+    c = net.counters()
+    assert c["last_round_examples_per_sec"] > 0
+    assert c["steps"] == 3 and c["examples"] == 24
+
+
 def test_net_multilabel_through_wrapper(tmp_path):
     """label_width=3 through the Python wrapper: a csv whose rows carry
     three binary labels feeds a multi_logistic + label_vec net via
