@@ -208,12 +208,19 @@ def _make_raw_rec(path: str, n: int = 2048, size: int = 256) -> None:
 
 def measure_pipeline(batch: int = 256, rec_path: str = "/tmp/bench.rec",
                      n_images: int = 2048, raw: bool = False,
-                     dispatch_period: int = 8):
-    """End-to-end throughput: imgrec -> decode pool -> augment (rand
-    crop 227 + mirror) -> batch -> threadbuffer prefetch -> device
-    train step. Returns (img/s end-to-end, duty cycle vs pure compute,
-    pure img/s, eval img/s) — the reference's >95% GPU-utilization
-    criterion (doc/debug_perf.md:3-5) measured the TPU way.
+                     dispatch_period: int = 8, precompile: bool = True,
+                     measure_pure: bool = True,
+                     measure_eval: bool = True):
+    """End-to-end throughput: imgrec -> decode pool -> vectorized
+    augment (rand crop 227 + mirror into the batch ring) -> zero-copy
+    batch -> threadbuffer prefetch (pipelined H2D) -> device train
+    step. Returns a dict: img/s end-to-end, duty cycle vs pure
+    compute, pure img/s, eval img/s — the reference's >95%
+    GPU-utilization criterion (doc/debug_perf.md:3-5) measured the TPU
+    way — plus the pipeline telemetry this PR's monitor records
+    (buffer-reuse rate, H2D overlap ratio, io_wait p50/p99, precompile
+    wall time), so ``BENCH_r*.json`` carries the machine-readable perf
+    trajectory of the input pipeline, not only the compute headline.
 
     raw=True uses pre-packed raw uint8 tensor records (no jpeg in the
     loop), bounding the NON-decode pipeline overhead on this host —
@@ -221,14 +228,19 @@ def measure_pipeline(batch: int = 256, rec_path: str = "/tmp/bench.rec",
     in doc/perf_profile.md."""
     from cxxnet_tpu.io import create_iterator
     from cxxnet_tpu.io.data import DataBatch
+    from cxxnet_tpu.io.iter_batch import pipeline_snapshot
     from cxxnet_tpu.models import alexnet
     from cxxnet_tpu.nnet.trainer import NetTrainer
     from cxxnet_tpu.utils.config import parse_config
 
+    # archive path carries the image count: the writers cache by bare
+    # path existence, so a smaller archive from an earlier run must not
+    # silently serve a larger request
     if raw:
-        rec_path = rec_path.replace(".rec", "_raw.rec")
+        rec_path = rec_path.replace(".rec", "_raw_%d.rec" % n_images)
         _make_raw_rec(rec_path, n_images)
     else:
+        rec_path = rec_path.replace(".rec", "_%d.rec" % n_images)
         _make_rec(rec_path, n_images)
     it = create_iterator(
         [("iter", "imgrec"), ("path_imgrec", rec_path),
@@ -238,10 +250,15 @@ def measure_pipeline(batch: int = 256, rec_path: str = "/tmp/bench.rec",
     it.init()
     t = NetTrainer(parse_config(alexnet(nclass=1000, batch_size=batch,
                                         image_size=227))
-                   + [("eval_train", "0"), ("dtype", "bfloat16")])
+                   + [("eval_train", "0"), ("dtype", "bfloat16"),
+                      ("precompile_dtype", "uint8")])
     t.init_model()
     if hasattr(it, "set_transform"):
         it.set_transform(t.device_put_batch)  # H2D in prefetch thread
+    from cxxnet_tpu.io.iter_batch import enable_chain_wait_stats
+    hist = enable_chain_wait_stats(it)
+    if precompile:
+        t.precompile(window=dispatch_period)
 
     def run_epoch(max_batches=None):
         """The CLI train loop's windowed dispatch (update_many every
@@ -261,30 +278,50 @@ def measure_pipeline(batch: int = 256, rec_path: str = "/tmp/bench.rec",
         _ = t.last_loss
         return n
 
-    # warmup epoch fragment: compile (window + tail paths) + fill
-    # prefetch
+    # warmup epoch fragment: compile whatever precompile didn't cover
+    # (window + tail paths) + fill prefetch
     run_epoch(max_batches=dispatch_period + 1)
+    pipeline_snapshot(it)                    # drop warmup counters
+    if hist is not None:
+        hist.reset()
 
     start = time.perf_counter()
     nimg = run_epoch()
     dt = time.perf_counter() - start
     e2e = nimg / dt
+    telemetry = pipeline_snapshot(it) or {}
+    io_snap = hist.snapshot() if hist is not None else {}
 
     # eval pass through the SAME pipeline (uint8 ship + prefetch H2D;
     # nnet_impl-inl.hpp:241-276 evaluates through the training input
     # path)
-    start = time.perf_counter()
-    nimg = 0
-    it.before_first()
-    for b in it:
-        t.predict(b)
-        nimg += b.batch_size - b.num_batch_padd
-    eval_ips = nimg / (time.perf_counter() - start)
+    eval_ips = 0.0
+    if measure_eval:
+        start = time.perf_counter()
+        nimg = 0
+        it.before_first()
+        for b in it:
+            t.predict(b)
+            nimg += b.batch_size - b.num_batch_padd
+        eval_ips = nimg / (time.perf_counter() - start)
     it.close()
 
     # pure-compute reference on a resident batch (test_skipread mode)
-    pure = measure(steps=50, batch=batch)["value"]
-    return e2e, min(e2e / pure, 1.0), pure, eval_ips
+    pure = measure(steps=50, batch=batch)["value"] if measure_pure \
+        else e2e
+    return {
+        "e2e": e2e,
+        "duty_cycle": min(e2e / pure, 1.0),
+        "pure": pure,
+        "eval_ips": eval_ips,
+        "buffer_reuse_rate": telemetry.get("buffer_reuse_rate", 0.0),
+        "h2d_overlap_ratio": telemetry.get("h2d_overlap_ratio", 0.0),
+        "io_wait_p50_ms": io_snap.get("p50_ms", 0.0),
+        "io_wait_p99_ms": io_snap.get("p99_ms", 0.0),
+        "io_wait_count": io_snap.get("count", 0),
+        "precompile_wall_ms": round(t.precompile_wall_s * 1e3, 1),
+        "precompile_programs": t.precompile_programs,
+    }
 
 
 def main():
@@ -327,16 +364,20 @@ def main():
             ap.error("--extra expects K=V, got %r" % kv)
     extra_cfg = tuple(kv.split("=", 1) for kv in args.extra)
     if args.pipeline or args.pipeline_raw:
-        e2e, duty, pure, eval_ips = measure_pipeline(
-            raw=args.pipeline_raw)
+        cap = measure_pipeline(raw=args.pipeline_raw)
         print(json.dumps({
             "metric": "end-to-end images/sec (imgrec pipeline%s)"
                       % (", raw records" if args.pipeline_raw else ""),
-            "value": round(e2e, 1),
+            "value": round(cap["e2e"], 1),
             "unit": "images/sec",
-            "duty_cycle_vs_pure_compute": round(duty, 3),
-            "pure_compute_images_per_sec": round(pure, 1),
-            "eval_images_per_sec": round(eval_ips, 1),
+            "duty_cycle_vs_pure_compute": round(cap["duty_cycle"], 3),
+            "pure_compute_images_per_sec": round(cap["pure"], 1),
+            "eval_images_per_sec": round(cap["eval_ips"], 1),
+            "buffer_reuse_rate": round(cap["buffer_reuse_rate"], 4),
+            "h2d_overlap_ratio": round(cap["h2d_overlap_ratio"], 4),
+            "io_wait_p50_ms": cap["io_wait_p50_ms"],
+            "io_wait_p99_ms": cap["io_wait_p99_ms"],
+            "precompile_wall_ms": cap["precompile_wall_ms"],
         }))
         return
     if args.model is not None:
@@ -391,6 +432,28 @@ def main():
         "suspect": any(c["suspect"] for c in models.values()),
         "models": models,
     }
+    # input-pipeline telemetry rides in every BENCH record from this
+    # round on (buffer-reuse rate, H2D overlap, io_wait p50/p99,
+    # precompile wall): a small raw-record run — decode-free, so it
+    # finishes fast and measures the pipeline itself, not libjpeg.
+    # dispatch_period=1 keeps it on the per-batch program: the K-window
+    # scan compiles for minutes on a contended tunnel chip and the
+    # pipeline counters don't need it
+    try:
+        pcap = measure_pipeline(batch=128, raw=True, n_images=256,
+                                dispatch_period=1,
+                                measure_pure=False, measure_eval=False)
+        out["pipeline"] = {
+            "e2e_images_per_sec": round(pcap["e2e"], 1),
+            "buffer_reuse_rate": round(pcap["buffer_reuse_rate"], 4),
+            "h2d_overlap_ratio": round(pcap["h2d_overlap_ratio"], 4),
+            "io_wait_p50_ms": pcap["io_wait_p50_ms"],
+            "io_wait_p99_ms": pcap["io_wait_p99_ms"],
+            "precompile_wall_ms": pcap["precompile_wall_ms"],
+        }
+    except Exception as e:               # telemetry must never sink the
+        out["pipeline"] = {"error": str(e)}   # headline capture
+
     if old is not None:
         out["compare"] = compare_models(old, models)
         out["compare_against"] = args.compare

@@ -19,7 +19,7 @@ keep describing shapes as (ch, y, x).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Iterator, List, Optional, Sequence, Tuple
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -35,12 +35,21 @@ class DataInst:
 
 @dataclass
 class DataBatch:
-    """A batch of instances (data.h:80-150)."""
+    """A batch of instances (data.h:80-150).
+
+    ``release`` is the host-buffer ownership hand-off: when the batch's
+    arrays live in a preallocated ring buffer (BatchAdapter's zero-copy
+    assembly), calling it returns the buffer for reuse. Only call it
+    once nothing will read the arrays again — the prefetch chain calls
+    it after the device copy completes. None means the arrays are
+    ordinary garbage-collected allocations.
+    """
     data: np.ndarray                  # (batch, y, x, ch) | (batch, features)
     label: np.ndarray                 # (batch, label_width)
     inst_index: Optional[np.ndarray] = None
     num_batch_padd: int = 0
     extra_data: List[np.ndarray] = field(default_factory=list)
+    release: Optional[Callable[[], None]] = None
 
     @property
     def batch_size(self) -> int:

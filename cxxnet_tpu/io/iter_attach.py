@@ -77,7 +77,9 @@ class AttachTxtIterator(IIterator):
         self._out = DataBatch(data=b.data, label=b.label,
                               inst_index=b.inst_index,
                               num_batch_padd=b.num_batch_padd,
-                              extra_data=[extra])
+                              extra_data=[extra],
+                              release=b.release)   # same storage: the
+        #                       ring lease travels with the rewrap
         return True
 
     def value(self) -> DataBatch:

@@ -15,6 +15,22 @@ and ``image_augmenter-inl.hpp:13-222``:
 
 All work happens host-side on NumPy instances, feeding the device
 pipeline — the TPU analogue of the reference's OpenCV host augmentation.
+
+Two execution modes:
+
+- **per-instance** (the general path): each instance is transformed by
+  ``_transform`` under its own seeded RNG, a thread pool warping a
+  chunk at a time. Required whenever affine warps, crop-resize
+  (``min_crop_size``/``max_crop_size``) or color jitter are configured.
+- **deferred / vectorized** (the no-affine fast path): when only
+  crop/mirror/mean/scale are in play, a downstream ``BatchAdapter``
+  calls :meth:`enable_deferred` and instances pass through raw; the
+  batch adapter then crops each row straight into its preallocated
+  batch buffer and applies mean/scale as whole-batch array ops — the
+  same math without the per-instance Python dispatch the GIL
+  serializes. Output is bit-identical (each row draws from the same
+  ``_inst_rng(index)`` stream); ``augment_vectorize = 0`` forces the
+  per-instance path.
 """
 
 from __future__ import annotations
@@ -66,6 +82,10 @@ class AugmentAdapter(IIterator):
         self._buf: List[DataInst] = []
         self._bufpos = 0
         self._chunk = 64
+        # batch-level vectorization (enabled by a downstream
+        # BatchAdapter when the knob set allows deferral)
+        self.vectorize = 1
+        self._deferred = False
 
     def set_param(self, name: str, val: str) -> None:
         self.base.set_param(name, val)
@@ -76,6 +96,8 @@ class AugmentAdapter(IIterator):
             self._seed_base = self.kRandMagic + int(val)
         if name == "augment_nthread":
             self.nthread = int(val)
+        if name == "augment_vectorize":
+            self.vectorize = int(val)
         if name == "rand_crop":
             self.rand_crop = int(val)
         if name == "crop_y_start":
@@ -250,6 +272,30 @@ class AugmentAdapter(IIterator):
             borderMode=cv2.BORDER_CONSTANT,
             borderValue=(self.fill_value,) * 3)    # preserves dtype
 
+    def _crop_start(self, rng: np.random.RandomState, h: int, w: int,
+                    ty: int, tx: int):
+        """Crop origin for the plain (non-resize) crop — ONE definition
+        of the coordinate logic and RNG draw order, shared by the
+        per-instance path and the vectorized batch path so they cannot
+        drift apart."""
+        if h < ty or w < tx:
+            raise ValueError(
+                "augment: input %dx%d smaller than target crop %dx%d"
+                % (h, w, ty, tx))
+        if self.rand_crop:
+            ys = rng.randint(h - ty + 1)
+            xs = rng.randint(w - tx + 1)
+        elif self.crop_y_start >= 0 or self.crop_x_start >= 0:
+            ys = max(self.crop_y_start, 0)
+            xs = max(self.crop_x_start, 0)
+        else:
+            ys, xs = (h - ty) // 2, (w - tx) // 2
+        return ys, xs
+
+    def _mirror_draw(self, rng: np.random.RandomState) -> bool:
+        """Mirror decision (shared draw order with the batch path)."""
+        return bool(self.mirror or (self.rand_mirror and rng.randint(2)))
+
     def _crop(self, img: np.ndarray,
               rng: np.random.RandomState) -> np.ndarray:
         _, ty, tx = self.shape
@@ -271,18 +317,7 @@ class AugmentAdapter(IIterator):
             return import_cv2.resize(patch, (tx, ty),
                                      interpolation=import_cv2.INTER_LINEAR)
         h, w = img.shape[:2]
-        if h < ty or w < tx:
-            raise ValueError(
-                "augment: input %dx%d smaller than target crop %dx%d"
-                % (h, w, ty, tx))
-        if self.rand_crop:
-            ys = rng.randint(h - ty + 1)
-            xs = rng.randint(w - tx + 1)
-        elif self.crop_y_start >= 0 or self.crop_x_start >= 0:
-            ys = max(self.crop_y_start, 0)
-            xs = max(self.crop_x_start, 0)
-        else:
-            ys, xs = (h - ty) // 2, (w - tx) // 2
+        ys, xs = self._crop_start(rng, h, w, ty, tx)
         return img[ys:ys + ty, xs:xs + tx]
 
     def _is_float_work(self) -> bool:
@@ -303,7 +338,7 @@ class AugmentAdapter(IIterator):
         img = data if keep_u8 else np.asarray(data, np.float32)
         img = self._affine(img, rng)
         img = self._crop(img, rng)
-        if self.mirror or (self.rand_mirror and rng.randint(2)):
+        if self._mirror_draw(rng):
             img = img[:, ::-1]
         if keep_u8:
             return np.ascontiguousarray(img)
@@ -327,7 +362,84 @@ class AugmentAdapter(IIterator):
                         label=inst.label,
                         extra_data=inst.extra_data)
 
+    # -- batch-level vectorized fast path --------------------------------
+
+    def can_defer(self) -> bool:
+        """True when _transform reduces to exactly what
+        assemble_deferred implements — plain crop (_crop_start) +
+        mirror (_mirror_draw) + mean/scale. The three exclusions below
+        are the three points where _transform does MORE: _affine warps
+        (gated by _need_affine), the crop-resize branch of _crop
+        (min/max_crop_size), and the contrast/illumination jitter tail.
+        Anyone adding a knob to _transform must either implement it in
+        assemble_deferred or add its gate here."""
+        return (bool(self.vectorize)
+                and not self._need_affine()
+                and not (self.min_crop_size > 0 and self.max_crop_size > 0)
+                and self.max_random_contrast == 0
+                and self.max_random_illumination == 0)
+
+    def enable_deferred(self) -> bool:
+        """Called by a downstream BatchAdapter after init: when the fast
+        path applies, instances pass through untransformed and the batch
+        adapter calls assemble_deferred() on the assembled buffer —
+        whole-batch NumPy ops instead of a GIL-bound per-instance pool.
+        Returns whether deferral is active."""
+        self._deferred = self.can_defer()
+        return self._deferred
+
+    def deferred_row_spec(self, inst: DataInst):
+        """(row_shape, dtype) a deferred batch buffer needs for this
+        instance stream — the post-crop shape and the same dtype rule
+        as _transform (uint8 survives only without float work)."""
+        data = np.asarray(inst.data)
+        if data.ndim != 3:
+            return data.shape, np.dtype(np.float32)
+        _, ty, tx = self.shape
+        keep_u8 = data.dtype == np.uint8 and not self._is_float_work()
+        return ((ty, tx, data.shape[2]),
+                np.dtype(np.uint8) if keep_u8 else np.dtype(np.float32))
+
+    def assemble_deferred(self, buf: np.ndarray,
+                          insts: List[DataInst]) -> None:
+        """Crop/mirror each instance into its row of ``buf`` (one
+        strided copy per row — the zero-copy assembly), then apply the
+        float work (mean/scale) as whole-batch array ops. Bit-identical
+        to the per-instance path: each row draws from the same
+        _inst_rng(index) stream in the same order, and the elementwise
+        float ops run in the same sequence."""
+        _, ty, tx = self.shape
+        for i, inst in enumerate(insts):
+            data = np.asarray(inst.data)
+            if data.ndim != 3:
+                buf[i] = data
+                continue
+            rng = self._inst_rng(inst.index)
+            h, w = data.shape[:2]
+            ys, xs = self._crop_start(rng, h, w, ty, tx)
+            view = data[ys:ys + ty, xs:xs + tx]
+            if self._mirror_draw(rng):
+                view = view[:, ::-1]
+            buf[i] = view
+        if buf.dtype == np.uint8 or buf.ndim < 2:
+            return
+        if buf.ndim == 4:
+            if self.meanimg is not None \
+                    and self.meanimg.shape == buf.shape[1:]:
+                buf -= self.meanimg
+            elif self.mean_value is not None:
+                buf -= self.mean_value
+        if self.scale != 1.0:
+            buf *= np.float32(self.scale)
+
     def next(self) -> bool:
+        if self._deferred:
+            # pass-through: the downstream BatchAdapter owns the
+            # transform (assemble_deferred on the whole batch)
+            if not self.base.next():
+                return False
+            self._out = self.base.value()
+            return True
         # chunked parallel transform: the reference augments inside its
         # OpenMP decode loop (iter_image_recordio-inl.hpp:214-250); here
         # a pool warps a chunk at a time
@@ -355,6 +467,9 @@ class AugmentAdapter(IIterator):
 
     def close(self) -> None:
         if self._pool is not None:
-            self._pool.shutdown(wait=False)
+            # cancel queued warp work too: a mid-chunk shutdown must not
+            # leave transforms running against buffers the caller is
+            # about to free (py3.9+ cancel_futures)
+            self._pool.shutdown(wait=False, cancel_futures=True)
             self._pool = None
         self.base.close()

@@ -221,7 +221,7 @@ class ImageRecordIterator(IIterator):
 
     def close(self) -> None:
         if self._pool is not None:
-            self._pool.shutdown(wait=False)
+            self._pool.shutdown(wait=False, cancel_futures=True)
             self._pool = None
         for r in self._readers:
             if hasattr(r, "close"):

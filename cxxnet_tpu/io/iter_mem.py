@@ -43,6 +43,11 @@ class MemBufferIterator(IIterator):
         if (self.max_nbatch == 0 or len(self.cache) < self.max_nbatch) \
                 and self.base.next():
             self._out = self.base.value()
+            if self._out.release is not None:
+                # the cache replays this batch every epoch: consume the
+                # ring-buffer lease so nothing downstream can hand the
+                # storage back for refill while it is cached
+                self._out.release = None
             self.cache.append(self._out)
             return True
         self.filled = True

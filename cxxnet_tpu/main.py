@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .io import create_iterator
+from .io.iter_batch import enable_chain_wait_stats, pipeline_snapshot
 from .monitor import (Monitor, create_monitor, device_memory_snapshot,
                       run_metadata, set_global)
 from .nnet.trainer import NetTrainer
@@ -60,6 +61,11 @@ class LearnTask:
         # amortizes host dispatch latency; schedule stays per-update
         # correct. 1 = per-batch update().
         self.dispatch_period = 8
+        # precompile = 1: AOT-compile the dispatch programs for the
+        # run's static shapes before round 0 (trainer.precompile);
+        # combined with compile_cache_dir the compiles amortize across
+        # runs (doc/observability.md)
+        self.precompile = 0
         # observability (doc/observability.md); a null monitor until
         # run() builds the configured one, so task methods are safe to
         # call directly in tests
@@ -116,6 +122,8 @@ class LearnTask:
             self.device = val
         if name == "dispatch_period":
             self.dispatch_period = max(1, int(val))
+        if name == "precompile":
+            self.precompile = int(val)
 
     # -- model files -----------------------------------------------------
 
@@ -313,13 +321,20 @@ class LearnTask:
         if monitored:
             mon.emit("run_start", **run_metadata(
                 self.task, self._cfg_stream, trainer.mesh))
-            if hasattr(itr_train, "enable_wait_stats"):
-                # batch-fetch latency histogram on the prefetch chain;
-                # attached only under an active monitor so the default
-                # path never pays the per-batch clock reads
-                io_hist = itr_train.enable_wait_stats()
-        start = time.time()
+            # batch-fetch latency histogram on the prefetch chain
+            # (found anywhere in the chain, not only outermost);
+            # attached only under an active monitor so the default
+            # path never pays the per-batch clock reads
+            io_hist = enable_chain_wait_stats(itr_train)
         k = self.dispatch_period
+        if self.precompile:
+            # AOT-compile every dispatch signature of the steady-state
+            # loop (per-batch tail, K-batch window, eval forward) before
+            # round 0: the round-0 recompile stalls collapse into one
+            # accounted precompile window, and the stream records zero
+            # compile events afterwards
+            trainer.precompile(window=k)
+        start = time.time()
 
         def _progress(r, nbatch):
             if (self.print_step and nbatch % self.print_step < k
@@ -384,6 +399,12 @@ class LearnTask:
                 if io_hist is not None:
                     mon.emit("io_wait", round=r, **io_hist.snapshot())
                     io_hist.reset()
+                ps = pipeline_snapshot(itr_train)
+                if ps is not None:
+                    # per-round input-pipeline health: buffer-reuse
+                    # rate of the zero-copy assembly, H2D overlap of
+                    # the prefetch staging (doc/observability.md)
+                    mon.emit("pipeline", round=r, **ps)
             if self.test_on_server:
                 # per-round weight consistency audit (the reference's
                 # test_on_server CheckWeight_, async_updater-inl.hpp:

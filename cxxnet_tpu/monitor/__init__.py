@@ -174,6 +174,26 @@ class LatencyHistogram:
                 return
         self.counts[-1] += 1
 
+    def percentile(self, q: float) -> float:
+        """Estimate the q-th percentile (q in [0, 1]) from the bucket
+        counts: linear interpolation inside the bucket the rank lands
+        in, capped by the observed max. Bucketed estimation keeps
+        observe() O(1); the power-of-two bounds give <=2x resolution,
+        plenty for 'did the tail collapse' comparisons."""
+        if self.n == 0:
+            return 0.0
+        rank = q * self.n
+        seen = 0
+        lo = 0.0
+        for i, hi in enumerate(self.BOUNDS):
+            c = self.counts[i]
+            if seen + c >= rank and c > 0:
+                frac = (rank - seen) / c
+                return min(lo + (hi - lo) * frac, self.max_ms)
+            seen += c
+            lo = hi
+        return self.max_ms               # rank in the open-ended bucket
+
     def snapshot(self) -> Dict[str, Any]:
         buckets = {}
         for i, b in enumerate(self.BOUNDS):
@@ -184,7 +204,10 @@ class LatencyHistogram:
         mean = self.total_ms / self.n if self.n else 0.0
         return {"count": self.n, "total_ms": round(self.total_ms, 3),
                 "mean_ms": round(mean, 3),
-                "max_ms": round(self.max_ms, 3), "buckets": buckets}
+                "max_ms": round(self.max_ms, 3),
+                "p50_ms": round(self.percentile(0.50), 3),
+                "p99_ms": round(self.percentile(0.99), 3),
+                "buckets": buckets}
 
 
 # -- monitor -------------------------------------------------------------

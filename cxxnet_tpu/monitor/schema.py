@@ -31,7 +31,14 @@ REQUIRED: Dict[str, tuple] = {
              "update_counter", "lr", "compile"),
     "compile": ("kind", "wall_ms", "signature"),
     "memory": ("round", "available", "devices"),
-    "io_wait": ("round", "count", "total_ms", "max_ms", "buckets"),
+    "io_wait": ("round", "count", "total_ms", "max_ms", "p50_ms",
+                "p99_ms", "buckets"),
+    # per-round input-pipeline health: zero-copy assembly reuse +
+    # prefetch H2D overlap (doc/observability.md)
+    "pipeline": ("round", "buffer_reuse_rate", "h2d_overlap_ratio",
+                 "batches", "h2d_ms", "consumer_wait_ms"),
+    # one-time AOT compile window (precompile = 1)
+    "precompile": ("wall_ms", "programs"),
     "eval": ("round", "name", "metrics"),
     "round_end": ("round", "examples", "wall_s", "examples_per_sec"),
     "trace_start": ("dir",),
@@ -44,8 +51,12 @@ REQUIRED: Dict[str, tuple] = {
 }
 
 _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",
-                "mean_ms", "wall_s", "examples_per_sec",
+                "mean_ms", "p50_ms", "p99_ms", "h2d_ms",
+                "consumer_wait_ms", "wall_s", "examples_per_sec",
                 "instances_per_sec")
+
+# ratio fields must sit in [0, 1]
+_RATIO_KEYS = ("buffer_reuse_rate", "h2d_overlap_ratio")
 
 
 def validate_record(rec: Dict[str, Any]) -> List[str]:
@@ -69,6 +80,12 @@ def validate_record(rec: Dict[str, Any]) -> List[str]:
                     or not math.isfinite(v)):
                 errs.append("%s: %s must be a non-negative finite "
                             "number, got %r" % (ev, key, v))
+    for key in _RATIO_KEYS:
+        if key in rec:
+            v = rec[key]
+            if not isinstance(v, (int, float)) or not (0 <= v <= 1):
+                errs.append("%s: %s must be a ratio in [0, 1], got %r"
+                            % (ev, key, v))
     return errs
 
 

@@ -92,6 +92,12 @@ class NetTrainer:
         self.dispatch_period = 8         # multi-process lockstep window
         #                                  (shared with the CLI loop's
         #                                  windowed dispatch)
+        self.compile_cache_dir = ""      # persistent XLA compilation
+        #                                  cache (compile once per
+        #                                  machine, not per run)
+        self.precompile_dtype = "float32"  # input dtype precompile()
+        #                                  lowers for (uint8 pipelines
+        #                                  set precompile_dtype=uint8)
         self.sample_counter = 0          # within accumulation window
         self.update_counter = 0          # applied updates (schedule epoch)
         self.round = 0
@@ -111,6 +117,11 @@ class NetTrainer:
         #                                  / recompile detection)
         self.last_round_examples = 0     # set by end_round
         self.last_round_wall_s = 0.0
+        # AOT-compiled executables keyed by dispatch signature
+        # (precompile()); empty = every dispatch goes through jit
+        self._aot: Dict[tuple, Any] = {}
+        self.precompile_wall_s = 0.0
+        self.precompile_programs = 0
 
     # -- config ----------------------------------------------------------
 
@@ -147,6 +158,13 @@ class NetTrainer:
                 self.remat_barrier = int(val)
             if name == "dispatch_period":
                 self.dispatch_period = max(1, int(val))
+            if name == "compile_cache_dir":
+                self.compile_cache_dir = val
+            if name == "precompile_dtype":
+                if val not in ("float32", "uint8"):
+                    raise ValueError(
+                        "precompile_dtype must be float32 or uint8")
+                self.precompile_dtype = val
             if name in ("shard_optimizer", "update_on_server"):
                 # update_on_server=1 meant "optimizer state lives off the
                 # workers" (nnet_ps_server.cpp); here it means "optimizer
@@ -179,6 +197,7 @@ class NetTrainer:
 
     def _post_init(self) -> None:
         """Everything shared by init_model and load_model."""
+        self._enable_persistent_cache()
         g = self.graph
         # one updater per (param layer, tag)
         self.updaters: Dict[str, Dict[str, Any]] = {}
@@ -236,6 +255,8 @@ class NetTrainer:
 
     def _build_steps(self) -> None:
         mesh = self.mesh
+        self._aot = {}                   # rebuilt programs orphan any
+        #                                  earlier AOT executables
         self._b_shard = batch_sharding(mesh)
         self._repl = replicated(mesh)
         self._repl_leaf = self._repl
@@ -488,6 +509,190 @@ class NetTrainer:
         self._pred_step = jax.jit(pred_step,
                                   static_argnames=("nodes_wanted",))
 
+    def _call_step(self, kind, sig, jit_fn, args, **static_kw):
+        """Dispatch one program: the AOT executable when precompile
+        built this exact signature (static args baked in), the jit
+        function otherwise. One code path so a key-scheme change cannot
+        silently strand a dispatch site on jit fallback."""
+        aot = self._aot.get((kind,) + sig) if self._aot else None
+        if aot is not None:
+            return aot(*args)
+        return jit_fn(*args, **static_kw)
+
+    def _call_pred(self, data, mask, extra, nodes_wanted):
+        sig = (data.shape, str(data.dtype), mask is None, len(extra),
+               nodes_wanted)
+        return self._call_step(
+            "pred", sig, self._pred_step,
+            (self.params, self.net_state, data, mask, extra),
+            nodes_wanted=nodes_wanted)
+
+    # -- AOT precompile --------------------------------------------------
+
+    def _enable_persistent_cache(self) -> None:
+        """Point jax at a persistent on-disk compilation cache
+        (``compile_cache_dir``): recompiles across RUNS become cache
+        deserializations — the first-round compile cost is paid once
+        per (program, jaxlib, flags) per machine."""
+        if not self.compile_cache_dir:
+            return
+        jax.config.update("jax_compilation_cache_dir",
+                          self.compile_cache_dir)
+        for k, v in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                     ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(k, v)
+            except Exception:            # knob not in this jax version
+                pass
+        try:
+            # drop the 'cache disabled' state memoized by any compile
+            # that ran before the dir was configured (library init,
+            # net.init) — without this the dir is set but never written
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            pass
+
+    def precompile(self, window: int = 1, n_steps: int = 0) -> int:
+        """AOT-compile the dispatch programs for the shapes this run
+        will use, before round 0 touches the device.
+
+        ``.lower().compile()``s the per-batch train step, the K-window
+        ``update_many`` step (``window`` > 1 — pass the CLI loop's
+        dispatch_period), the eval/pred forward, and (``n_steps`` > 0)
+        the ``run_steps`` scan, each for every mask variant the run can
+        dispatch. The compiled executables are kept and dispatched
+        directly (no jit-cache round trip), so the steady-state loop
+        never sees a compile: the recompile stalls PR 1's telemetry
+        records in round 0 move to a single accounted precompile window
+        — and with ``compile_cache_dir`` set they amortize across runs.
+
+        Shapes must be fully known: batch_size from the config, the
+        instance shape from ``input_shape``, input dtype from
+        ``precompile_dtype`` (uint8 for raw-pixel pipelines). Nets with
+        ``extra_data`` inputs and eval iterators with a different
+        batch_size fall back to the jit path for those dispatches —
+        precompile never changes results, only when compilation
+        happens. Returns the number of programs compiled."""
+        assert self._initialized, "call init_model/load_model first"
+        from ..io.data import inst_array_shape
+        t_start = time.perf_counter()
+        self._enable_persistent_cache()
+        dtype = np.dtype(np.uint8 if self.precompile_dtype == "uint8"
+                         else np.float32)
+        # GLOBAL batch shapes: multi-process dispatch arrays come out of
+        # make_array_from_process_local_data with the global leading dim
+        # (each rank contributes batch_size/world rows), and the runtime
+        # signature keys use those global shapes
+        n = self.batch_size
+        data_shape = (n,) + inst_array_shape(
+            tuple(self.graph.input_shape))
+        lw = max((b for _, _a, b in self._label_slices), default=1)
+        label_shape = (n, lw)
+
+        def sds(shape, dt, sharding=None):
+            if sharding is None:
+                return jax.ShapeDtypeStruct(shape, dt)
+            return jax.ShapeDtypeStruct(shape, dt, sharding=sharding)
+
+        data_s = sds(data_shape, dtype, self._b_shard)
+        labels_s = sds(label_shape, np.float32, self._b_shard)
+        hyper_s = sds((len(self._hyper_index), 4), np.float32)
+        step_s = sds((), np.uint32)
+        # the None-mask specialization only exists single-process
+        # (multi-process dp always materializes the mask — see _mask)
+        mask_variants = [None, sds((n,), np.float32, self._b_shard)]
+        if jax.process_count() > 1:
+            mask_variants = [sds((n,), np.float32, self._b_shard)]
+        do_up_variants = [True] if self.update_period == 1 \
+            else [True, False]
+        dt_str = str(dtype)
+        programs = []                    # (key, lower_thunk)
+
+        for mask_v in mask_variants:
+            for du in do_up_variants:
+                key = ("update", data_shape, dt_str, label_shape,
+                       mask_v is None, 0, bool(du))
+                programs.append((key, lambda m=mask_v, d=du:
+                                 self._train_step.lower(
+                                     self.params, self.opt_state,
+                                     self.net_state, self.grad_acc,
+                                     data_s, labels_s, m, (), hyper_s,
+                                     step_s, self._base_key,
+                                     do_update=d)))
+            if window > 1:
+                K = int(window)
+                data_k_s = sds((K,) + data_shape, dtype, self._kb_shard)
+                labels_k_s = sds((K,) + label_shape, np.float32,
+                                 self._kb_shard)
+                mask_k = None if mask_v is None \
+                    else sds((K, n), np.float32, self._kb_shard)
+                hyper_k_s = sds((K, len(self._hyper_index), 4),
+                                np.float32)
+                do_up_s = sds((K,), np.bool_)
+                collect = bool(self.eval_train and self._metrics.evals)
+                key = ("update_many", (K,) + data_shape, dt_str,
+                       (K,) + label_shape, mask_k is None, 0, K,
+                       collect)
+                programs.append((key, lambda mk=mask_k, c=collect,
+                                 ds=data_k_s, ls=labels_k_s,
+                                 hs=hyper_k_s, us=do_up_s:
+                                 self._many_step.lower(
+                                     self.params, self.opt_state,
+                                     self.net_state, self.grad_acc,
+                                     ds, ls, mk, (), hs, us, step_s,
+                                     self._base_key, collect=c)))
+            if n_steps > 0:
+                hyper_k_s = sds((int(n_steps), len(self._hyper_index),
+                                 4), np.float32)
+                key = ("run_steps", data_shape, dt_str, label_shape,
+                       mask_v is None, 0, int(n_steps))
+                programs.append((key, lambda m=mask_v, hs=hyper_k_s:
+                                 self._multi_step.lower(
+                                     self.params, self.opt_state,
+                                     self.net_state, data_s, labels_s,
+                                     m, (), hs, step_s,
+                                     self._base_key)))
+            if self._metric_nodes:
+                nodes = tuple(self._metric_nodes)
+                key = ("pred", data_shape, dt_str, mask_v is None, 0,
+                       nodes)
+                programs.append((key, lambda m=mask_v, nw=nodes:
+                                 self._pred_step.lower(
+                                     self.params, self.net_state,
+                                     data_s, m, (),
+                                     nodes_wanted=nw)))
+
+        compiled = 0
+        for key, thunk in programs:
+            if key in self._aot:
+                continue
+            try:
+                t0 = time.perf_counter()
+                self._aot[key] = thunk().compile()
+            except Exception as e:
+                from ..monitor import warn_once
+                warn_once("precompile_failed",
+                          "precompile of %r failed (falling back to "
+                          "jit): %s" % (key[0], e))
+                continue
+            compiled += 1
+            # seed the signature set: the run's first dispatch of this
+            # signature is NOT a compile — it happened here, and the
+            # stream records it with its own wall time
+            self._seen_sigs.add(key)
+            if self._mon_on():
+                self._mon.emit("compile", kind="precompile",
+                               wall_ms=(time.perf_counter() - t0) * 1e3,
+                               signature=repr(key))
+        self.precompile_wall_s = time.perf_counter() - t_start
+        self.precompile_programs = compiled
+        if self._mon_on():
+            self._mon.emit("precompile",
+                           wall_ms=self.precompile_wall_s * 1e3,
+                           programs=compiled)
+        return compiled
+
     # -- hyper-params per step ------------------------------------------
 
     def _hyper(self, epoch: Optional[int] = None) -> np.ndarray:
@@ -586,7 +791,11 @@ class NetTrainer:
         return DataBatch(
             data=self._put_batch_array(batch.data),
             label=self._put_batch_array(batch.label),
-            inst_index=batch.inst_index,
+            # copy: the source may be a ring buffer that is released
+            # (and refilled) once the device arrays are ready, while
+            # this staged batch lives on until consumed
+            inst_index=None if batch.inst_index is None
+            else np.array(batch.inst_index),
             num_batch_padd=batch.num_batch_padd,
             extra_data=[self._put_batch_array(e)
                         for e in batch.extra_data])
@@ -733,11 +942,13 @@ class NetTrainer:
         step = self._step_scalar()
         self.sample_counter += 1
         do_update = self.sample_counter >= self.update_period
-        out = self._train_step(self.params, self.opt_state,
-                               self.net_state, self.grad_acc,
-                               data, labels, mask, extra, hyper,
-                               step, self._base_key,
-                               do_update=bool(do_update))
+        sig = (data.shape, str(data.dtype), labels.shape,
+               mask is None, len(extra), bool(do_update))
+        out = self._call_step(
+            "update", sig, self._train_step,
+            (self.params, self.opt_state, self.net_state, self.grad_acc,
+             data, labels, mask, extra, hyper, step, self._base_key),
+            do_update=bool(do_update))
         (self.params, self.opt_state, self.net_state,
          self.grad_acc, loss, preds) = out
         self._last_loss = loss
@@ -746,8 +957,6 @@ class NetTrainer:
         if self._mon_on():
             jax.block_until_ready(loss)
             wall = time.perf_counter() - t0
-            sig = (data.shape, str(data.dtype), labels.shape,
-                   mask is None, len(extra), bool(do_update))
             self._emit_step("update", 1, ex, wall, sig,
                             float(hyper[0, 0]) if len(hyper) else 0.0)
         if do_update:
@@ -772,20 +981,20 @@ class NetTrainer:
         data, labels, mask, extra = self._device_batch(batch)
         hyper_k = np.stack([self._hyper(self.update_counter + i)
                             for i in range(int(n_steps))])
-        out = self._multi_step(self.params, self.opt_state,
-                               self.net_state, data, labels, mask,
-                               extra, hyper_k,
-                               self._step_scalar(), self._base_key)
+        n = int(n_steps)
+        sig = (data.shape, str(data.dtype), labels.shape,
+               mask is None, len(extra), n)
+        out = self._call_step(
+            "run_steps", sig, self._multi_step,
+            (self.params, self.opt_state, self.net_state, data, labels,
+             mask, extra, hyper_k, self._step_scalar(), self._base_key))
         (self.params, self.opt_state, self.net_state, loss) = out
         self._last_loss = loss
-        n = int(n_steps)
         ex = (self._local_batch_size(batch) - batch.num_batch_padd) * n
         self._count_examples(ex)
         if self._mon_on():
             jax.block_until_ready(loss)
             wall = time.perf_counter() - t0
-            sig = (data.shape, str(data.dtype), labels.shape,
-                   mask is None, len(extra), n)
             self._emit_step("run_steps", n, ex, wall, sig,
                             float(hyper_k[0, 0, 0]) if hyper_k.size
                             else 0.0)
@@ -830,11 +1039,14 @@ class NetTrainer:
             self._put_window([b.extra_data[j] for b in batches])
             for j in range(n_extra))
         collect = bool(self.eval_train and self._metrics.evals)
-        out = self._many_step(self.params, self.opt_state,
-                              self.net_state, self.grad_acc,
-                              data_k, labels_k, mask_k, extra_k,
-                              hyper_k, do_up, step0, self._base_key,
-                              collect=collect)
+        sig = (data_k.shape, str(data_k.dtype), labels_k.shape,
+               mask_k is None, n_extra, K, collect)
+        out = self._call_step(
+            "update_many", sig, self._many_step,
+            (self.params, self.opt_state, self.net_state, self.grad_acc,
+             data_k, labels_k, mask_k, extra_k, hyper_k, do_up, step0,
+             self._base_key),
+            collect=collect)
         (self.params, self.opt_state, self.net_state, self.grad_acc,
          loss, preds_k) = out
         self._last_loss = loss
@@ -844,8 +1056,6 @@ class NetTrainer:
         if self._mon_on():
             jax.block_until_ready(loss)
             wall = time.perf_counter() - t0
-            sig = (data_k.shape, str(data_k.dtype), labels_k.shape,
-                   mask_k is None, n_extra, K, collect)
             self._emit_step("update_many", K, ex, wall, sig,
                             float(hyper_k[0, 0, 0]) if hyper_k.size
                             else 0.0)
@@ -882,11 +1092,10 @@ class NetTrainer:
             # the H2D bytes) and pre-placed prefetch batches pass
             # through (reference evaluates through the training pipeline,
             # nnet_impl-inl.hpp:241-276)
-            vals = self._pred_step(self.params, self.net_state,
-                                   self._put_batch_array(batch.data),
+            vals = self._call_pred(self._put_batch_array(batch.data),
                                    self._put_mask(batch),
                                    self._device_extra(batch),
-                                   nodes_wanted=nodes_wanted)
+                                   nodes_wanted)
             nvalid = self._local_batch_size(batch) - batch.num_batch_padd
             pred_np = [self._local_rows(v)[:nvalid] for v in vals]
             self._metrics.add_eval(
@@ -905,11 +1114,9 @@ class NetTrainer:
         """argmax class (or raw scalar) per row of the top node
         (nnet_impl-inl.hpp:317-330)."""
         top = self.graph.num_nodes - 1
-        (val,) = self._pred_step(self.params, self.net_state,
-                                 self._put_batch_array(batch.data),
+        (val,) = self._call_pred(self._put_batch_array(batch.data),
                                  self._put_mask(batch),
-                                 self._device_extra(batch),
-                                 nodes_wanted=(top,))
+                                 self._device_extra(batch), (top,))
         nvalid = self._local_batch_size(batch) - batch.num_batch_padd
         m = self._local_rows(val)[:nvalid]
         if m.shape[1] == 1:
@@ -918,11 +1125,9 @@ class NetTrainer:
 
     def extract_feature(self, batch: DataBatch, node: str) -> np.ndarray:
         ni = self.net.node_index_by_name(node)
-        (val,) = self._pred_step(self.params, self.net_state,
-                                 self._put_batch_array(batch.data),
+        (val,) = self._call_pred(self._put_batch_array(batch.data),
                                  self._put_mask(batch),
-                                 self._device_extra(batch),
-                                 nodes_wanted=(ni,))
+                                 self._device_extra(batch), (ni,))
         nvalid = self._local_batch_size(batch) - batch.num_batch_padd
         return self._local_rows(val, flatten=False)[:nvalid]
 
