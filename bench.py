@@ -64,6 +64,36 @@ def agreeing_spread(dts):
     return s[1] / s[0]
 
 
+def load_compare_record(path):
+    """Parse + validate a prior BENCH record for --compare, BEFORE the
+    minutes-long sweep. Returns the old ``models`` map; raises
+    ValueError on anything corrupt: no usable record at all, or a
+    model value that is not a finite number > 0 (a 0.0 in a hand-edited
+    record used to surface as a ZeroDivisionError after the sweep).
+    Single-model records keep their OWN capture fields (spread/suspect)
+    so the tolerance doesn't silently fall back to the 1.2 floor."""
+    with open(path) as f:
+        prev = json.load(f)
+    prev = prev.get("parsed") or prev if isinstance(prev, dict) else prev
+    if not isinstance(prev, dict) or (
+            "models" not in prev and "value" not in prev):
+        raise ValueError("%s has no usable bench record" % path)
+    if prev.get("models"):
+        old = prev["models"]
+    else:
+        old = {"alexnet": {k: prev[k]
+                           for k in ("value", "spread", "suspect")
+                           if k in prev}}
+    for m, v in old.items():
+        ov = v.get("value") if isinstance(v, dict) else v
+        if (not isinstance(ov, (int, float)) or isinstance(ov, bool)
+                or not np.isfinite(ov) or not ov > 0):
+            raise ValueError(
+                "%s: model %r has corrupt value %r (must be a finite "
+                "number > 0)" % (path, m, ov))
+    return old
+
+
 def compare_models(old, new, floor=1.2):
     """Spread-aware per-model comparison of two BENCH ``models`` maps.
 
@@ -99,20 +129,40 @@ def compare_models(old, new, floor=1.2):
     return out
 
 
-# model name (= builder in cxxnet_tpu.models) -> (default batch, image
-# size); image sizes follow the reference confs: AlexNet 227
-# (ImageNet/README.md), Inception-BN and kaiming 224.
+# bench model -> (builder in cxxnet_tpu.models, default batch, image
+# size, model-specific config); image sizes follow the reference confs:
+# AlexNet 227 (ImageNet/README.md), Inception-BN and kaiming 224.
+#
+# inception_bn carries the layout/fusion knobs this model class needs
+# (doc/perf_profile.md "layout cliffs and channel alignment"):
+# bn_fuse_relu collapses the ~30 BN+relu epilogue chains,
+# channel_pad=128 aligns the narrow conv outputs onto full lane groups
+# (overhead-guarded), input_layout pins the batch input channels-minor
+# so the compiler cannot pick the batch-minor cliff layout.
+# alexnet_up2 is the reference's canonical update_period=2 batch-128
+# AlexNet config (ImageNet/alexnet.conf), benchmarked in the fused
+# run_steps mode now that it accepts accumulation windows; the
+# headline metric stays the batch-256 'alexnet' entry for cross-round
+# comparability.
 MODELS = {
-    "alexnet": (256, 227),
-    "inception_bn": (128, 224),
-    "kaiming": (128, 224),
+    "alexnet": ("alexnet", 256, 227, ()),
+    "alexnet_up2": ("alexnet", 128, 227,
+                    (("update_period", "2"),
+                     ("input_layout", "rowmajor"))),
+    "inception_bn": ("inception_bn", 128, 224,
+                     (("bn_fuse_relu", "1"),
+                      ("channel_pad", "128"),
+                      ("channel_pad_max_overhead", "0.34"),
+                      ("input_layout", "rowmajor"))),
+    "kaiming": ("kaiming", 128, 224, ()),
 }
 
 
 def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
             dtype: str = "bfloat16",
-            grad_dtype: str = "float32",
-            extra: tuple = (), builder_kw: dict = None) -> float:
+            grad_dtype: str = "bfloat16",
+            extra: tuple = (), builder_kw: dict = None,
+            peak_tflops: float = 0.0) -> float:
     import jax
     import cxxnet_tpu.models as zoo
     from cxxnet_tpu.io.data import DataBatch
@@ -121,21 +171,24 @@ def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
     from cxxnet_tpu.nnet.trainer import NetTrainer
     from cxxnet_tpu.utils.config import parse_config
 
-    default_batch, size = MODELS[model]
+    builder_name, default_batch, size, model_cfg = MODELS[model]
     if batch is None:
         batch = default_batch
-    builder = getattr(zoo, model)
+    builder = getattr(zoo, builder_name)
     # momentum_dtype=bfloat16: +1.9-2.6% measured (doc/perf_profile.md
     # r5), convergence-gated by the bf16 MNIST conv gate — part of the
     # TPU-idiomatic training configuration like dtype=bfloat16.
-    # grad_dtype stays f32 by default (negative single-chip, r3).
+    # grad_dtype=bfloat16 joined it this round: halved cotangent HBM
+    # bytes on the roofline-bound bench models (and halved gradient
+    # all-reduce traffic under dp); f32 master weights and f32 metric
+    # extraction stay, --grad-dtype float32 restores the old path.
     t = NetTrainer(parse_config(builder(nclass=1000, batch_size=batch,
                                         image_size=size,
                                         **(builder_kw or {})))
                    + [("eval_train", "0"), ("dtype", dtype),
                       ("grad_dtype", grad_dtype),
                       ("momentum_dtype", "bfloat16"), ("silent", "1")]
-                   + list(extra))
+                   + list(model_cfg) + list(extra))
     t.init_model()
 
     rng = np.random.RandomState(0)
@@ -152,24 +205,44 @@ def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
     # BENCH_r*.json fields and a training run's monitor.jsonl report
     # through one code path (doc/observability.md)
     sink = MemorySink()
-    t.set_monitor(Monitor(sink))
-    t.run_steps(b, steps)                   # compile + warmup (same n)
+    t.set_monitor(Monitor(sink))            # emits model_info + layout
+    validate_records(sink.records)
+    recs = {r["event"]: r for r in sink.records}
+    flops_img = recs.get("model_info", {}).get(
+        "train_flops_per_example", 0.0)
+    layout_rec = {k: v for k, v in recs.get("layout", {}).items()
+                  if k not in ("event", "t")}
+    # AOT-compile the run_steps program up front (the accounted
+    # precompile window); the timed windows then never see a compile —
+    # the stream records it as compile=False on every step
+    t.precompile(n_steps=steps, per_batch=False)
+    t.run_steps(b, steps)                   # warmup (same n)
+
+    compiled_in_window = []
 
     def window():
         sink.clear()
         t.run_steps(b, steps)
         validate_records(sink.records)
         (rec,) = [r for r in sink.records if r["event"] == "step"]
+        compiled_in_window.append(bool(rec["compile"]))
         return rec["wall_ms"] / 1e3
 
     best, dts, suspect = capture(window)
     n_chips = max(len(jax.devices()), 1)
-    return {
-        "value": round(steps * batch / best / n_chips, 1),
+    ips = steps * batch / best / n_chips
+    out = {
+        "value": round(ips, 1),
         "dt": [round(d, 4) for d in dts],
         "spread": round(agreeing_spread(dts), 3),
         "suspect": suspect,
+        "zero_recompiles": not any(compiled_in_window),
+        "flops_per_img": flops_img,
+        "layout": layout_rec,
     }
+    if peak_tflops > 0 and flops_img > 0:
+        out["mfu"] = round(ips * flops_img / (peak_tflops * 1e12), 4)
+    return out
 
 
 def _make_rec(path: str, n: int = 2048, size: int = 256) -> None:
@@ -340,9 +413,14 @@ def main():
                          "read 2-4%% low — doc/perf_profile.md r4)")
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--grad-dtype", choices=["float32", "bfloat16"],
-                    default="float32",
+                    default="bfloat16",
                     help="gradient/cotangent dtype (f32 master weights "
-                         "either way)")
+                         "either way); bf16 is the bench default — "
+                         "half the cotangent HBM/ICI bytes")
+    ap.add_argument("--peak-tflops", type=float, default=0.0,
+                    help="chip peak TFLOP/s for the compute dtype; "
+                         "when set, each model's record carries "
+                         "whole-step MFU from the analytic FLOP count")
     ap.add_argument("--extra", action="append", default=[],
                     metavar="K=V",
                     help="extra config pairs for perf experiments "
@@ -353,7 +431,9 @@ def main():
                     help="after measuring all models, diff against a "
                          "prior BENCH_r*.json (or raw bench line) and "
                          "flag per-model deltas beyond recorded "
-                         "spread; exit 1 on regression")
+                         "spread; exit 1 on regression, 3 when any "
+                         "verdict is suspect (2 = usage/corrupt "
+                         "record, argparse's)")
     args = ap.parse_args()
     if args.compare and (args.model or args.pipeline or
                          args.pipeline_raw):
@@ -384,11 +464,12 @@ def main():
         model = args.model
         steps = args.steps if args.steps is not None else 200
         cap = measure(steps=steps, batch=args.batch, model=model,
-                      grad_dtype=args.grad_dtype, extra=extra_cfg)
+                      grad_dtype=args.grad_dtype, extra=extra_cfg,
+                      peak_tflops=args.peak_tflops)
         # 'AlexNet' spelling keeps the canonical BENCH metric name
         # stable across rounds
         name = "AlexNet" if model == "alexnet" else model
-        print(json.dumps({
+        rec = {
             "metric": "images/sec/chip on ImageNet %s" % name,
             "value": cap["value"],
             "unit": "images/sec/chip",
@@ -397,7 +478,12 @@ def main():
             "dt": cap["dt"],
             "spread": cap["spread"],
             "suspect": cap["suspect"],
-        }))
+            "zero_recompiles": cap["zero_recompiles"],
+            "layout": cap["layout"],
+        }
+        if "mfu" in cap:
+            rec["mfu"] = cap["mfu"]
+        print(json.dumps(rec))
         return
     # default: measure ALL models sequentially (one JSON line; the
     # headline metric/value stays AlexNet for cross-round driver
@@ -409,19 +495,17 @@ def main():
     if args.compare:
         # parse + validate BEFORE the minutes-long sweep so a corrupt
         # record (e.g. "parsed": null from a failed round) fails fast
-        with open(args.compare) as f:
-            prev = json.load(f)
-        prev = prev.get("parsed") or prev
-        if not isinstance(prev, dict) or (
-                "models" not in prev and "value" not in prev):
-            ap.error("%s has no usable bench record" % args.compare)
-        old = prev.get("models") or {"alexnet": prev["value"]}
+        try:
+            old = load_compare_record(args.compare)
+        except ValueError as e:
+            ap.error(str(e))
     import gc
     models = {}
     for m in sorted(MODELS):
         steps = args.steps if args.steps is not None else 200
         models[m] = measure(steps=steps, model=m,
-                            grad_dtype=args.grad_dtype, extra=extra_cfg)
+                            grad_dtype=args.grad_dtype, extra=extra_cfg,
+                            peak_tflops=args.peak_tflops)
         gc.collect()                     # free HBM before the next model
     head = models["alexnet"]
     out = {
@@ -458,9 +542,18 @@ def main():
         out["compare"] = compare_models(old, models)
         out["compare_against"] = args.compare
     print(json.dumps(out))
-    if args.compare and any(v["verdict"] == "regression"
-                            for v in out["compare"].values()):
-        raise SystemExit(1)
+    if args.compare:
+        verdicts = [v["verdict"] for v in out["compare"].values()]
+        if "regression" in verdicts:
+            raise SystemExit(1)
+        if "suspect" in verdicts:
+            # distinct exit code: an untrustworthy capture (a stalled
+            # window on either side) must not pass the regression gate
+            # as if it were a clean sweep (ADVICE r5). 3, not 2 —
+            # argparse owns exit 2 for usage/corrupt-record errors,
+            # and a CI gate must be able to tell "re-run the sweep"
+            # from "fix the record"
+            raise SystemExit(3)
 
 
 if __name__ == "__main__":

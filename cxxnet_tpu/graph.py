@@ -315,6 +315,17 @@ class NetGraph:
                     raise ConfigError(
                         "layer %d references invalid node %d" % (li, ni))
 
+    def node_consumers(self) -> Dict[int, List[int]]:
+        """node index -> layer indices reading it (graph adjacency for
+        the fusion/layout passes in nnet/net.py: out-degree-1 checks
+        decide where BN folds into its conv and where channel padding
+        provably fuses away)."""
+        cons: Dict[int, List[int]] = {}
+        for li, info in enumerate(self.layers):
+            for ni in info.nindex_in:
+                cons.setdefault(ni, []).append(li)
+        return cons
+
     def effective_type(self, layer_index: int) -> str:
         """Resolve shared layers to their primary layer's type."""
         info = self.layers[layer_index]

@@ -63,6 +63,11 @@ _FACTORY: Dict[str, Callable[..., Layer]] = {
     "prelu": lambda cfg, **kw: PReluLayer(cfg),
     "batch_norm": lambda cfg, **kw: BatchNormLayer(True, cfg),
     "batch_norm_no_ma": lambda cfg, **kw: BatchNormLayer(False, cfg),
+    # fused-epilogue variant: the folded scale/shift(+relu) runs as one
+    # Pallas pass (pallas_kernels.bn_apply); numerically identical to
+    # batch_norm with bn_fold_affine — pairtest-validated
+    "pallas_batch_norm": lambda cfg, **kw: BatchNormLayer(
+        True, cfg, use_pallas=True),
     # cross-framework oracle (the caffe adapter equivalent): a torch-
     # backed fullc/conv for pairtest-conv-torch style in-net A/B checks
     "torch": lambda cfg, **kw: TorchLayer(cfg),

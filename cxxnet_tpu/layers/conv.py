@@ -114,7 +114,9 @@ class ConvolutionLayer(Layer):
         """
         p = self.param
         s = p.stride
-        k, c, o = p.kernel_height, x.shape[-1], p.num_channel
+        # channel counts come from the operands (physical under the
+        # channel_pad pass), not the logical layer params
+        k, c, o = p.kernel_height, x.shape[-1], w.shape[-1]
         kp = -(-k // s) * s                   # kernel padded to mult of s
         b, h, wd = x.shape[0], x.shape[1], x.shape[2]
         oy = (h - k) // s + 1
@@ -145,6 +147,31 @@ class ConvolutionLayer(Layer):
         p = self.param
         x = inputs[0]
         w = params["wmat"]
+        # BN epilogue folded into the conv (eval/pred path): the net's
+        # bn_fold_eval pass injects the per-out-channel _fold_scale /
+        # _fold_shift (from the BN's running stats) and the downstream
+        # BN runs as identity — w*scale folds into the (small) weight
+        # tensor, deleting the per-layer elementwise pass entirely
+        fold_scale = params.get("_fold_scale")
+        if fold_scale is not None:
+            w = w * fold_scale          # f32, per out channel (HWIO)
+        # channel-alignment annotations (nnet/layout.py): zero weight
+        # rows absorb a padded input's dead channels, zero weight
+        # columns emit an aligned (padded) output — both provably-zero
+        # extensions of the same contraction, bit-identical math
+        in_layout = getattr(self, "_in_layout", None)
+        if in_layout is not None:
+            parts, off = [], 0
+            for valid, padc in in_layout:
+                parts.append(w[:, :, off:off + valid, :])
+                if padc:
+                    parts.append(jnp.zeros(
+                        w.shape[:2] + (padc, w.shape[3]), w.dtype))
+                off += valid
+            w = jnp.concatenate(parts, axis=2)
+        out_pad = getattr(self, "_out_pad", 0)
+        if out_pad:
+            w = jnp.pad(w, ((0, 0), (0, 0), (0, 0), (0, out_pad)))
         bf16 = p.compute_dtype == "bfloat16"
         if bf16:
             # both operands bf16, output bf16 (the conv VJP requires
@@ -180,8 +207,20 @@ class ConvolutionLayer(Layer):
         # bf16 outputs stay bf16: activations ride low-precision through
         # relu/pool/lrn to the loss (which upcasts) — per-layer
         # f32 round-trips were a wall of convert fusions in the profile
-        if p.no_bias == 0:
-            y = y + params["bias"].astype(y.dtype)
+        if fold_scale is not None:
+            b = params["_fold_shift"]
+            if p.no_bias == 0:
+                b = b + params["bias"] * fold_scale
+        elif p.no_bias == 0:
+            b = params["bias"]
+        else:
+            b = None
+        if b is not None:
+            if out_pad:                   # padded channels stay zero
+                b = jnp.pad(b, ((0, out_pad),))
+            y = y + b.astype(y.dtype)
+        if fold_scale is not None and "_fold_relu" in params:
+            y = jax.nn.relu(y)
         # named for the remat=conv policy (trainer._wrap_loss_fn): under
         # save_only_these_names("conv_out") the backward keeps conv
         # outputs and recomputes BN/activation/pool between them;
@@ -381,13 +420,18 @@ class BatchNormLayer(Layer):
 
     needs_mask = True
 
-    def __init__(self, moving_avg: bool, cfg=()):
+    def __init__(self, moving_avg: bool, cfg=(), use_pallas: bool = False):
         self.moving_avg = moving_avg
         self.init_slope = 1.0
         self.init_bias = 0.0
         self.eps = 1e-10
         self.bn_momentum = 0.9
         self.channel = 0
+        self.use_pallas = use_pallas
+        # set by the net-level bn_fuse_relu pass (nnet/net.py): the
+        # relu consuming this BN's output runs inside this layer and
+        # the relu connection becomes identity — same math, one pass
+        self.fuse_relu = False
         super().__init__(cfg)
 
     def set_param(self, name, val):
@@ -400,6 +444,8 @@ class BatchNormLayer(Layer):
             self.eps = float(val)
         if name == "bn_momentum":
             self.bn_momentum = float(val)
+        if name == "bn_pallas":
+            self.use_pallas = bool(int(val))
 
     def infer_shape(self, in_shapes: List[Shape3]) -> List[Shape3]:
         s = self._expect_one(in_shapes)
@@ -452,9 +498,29 @@ class BatchNormLayer(Layer):
         var = jnp.maximum(s2 / n - mean * mean, 0.0)
         return mean, var
 
+    def _apply(self, x, scale, shift):
+        """The folded per-channel epilogue (+ fused relu), through the
+        Pallas kernel when configured — scale/shift in f32, applied in
+        the compute dtype (identical arithmetic on both paths, pinned
+        by pairtest-batch_norm-pallas_batch_norm)."""
+        if self.use_pallas:
+            from .pallas_kernels import bn_apply
+            return bn_apply(x, scale, shift, self.fuse_relu)
+        out = x * scale.astype(x.dtype) + shift.astype(x.dtype)
+        return jax.nn.relu(out) if self.fuse_relu else out
+
     def forward(self, params, state, inputs, is_train, rng, mask=None):
         x = inputs[0]
         slope, bias = params["wmat"], params["bias"]
+        # channel-alignment (nnet/layout.py): slope/bias scatter into
+        # the physical channel positions with ZEROS in the pad gaps, so
+        # padded channels come out exactly 0 (0*x + 0) and their
+        # cotangents vanish; running stats stay logical in state
+        layout = getattr(self, "_layout", None)
+        if layout is not None:
+            from ..nnet.layout import pad_channel_vec, take_valid
+            slope = pad_channel_vec(slope, layout)
+            bias = pad_channel_vec(bias, layout)
         if is_train:
             mean, var = self._moments(x, mask)
             if self.param.bn_fold_affine:
@@ -470,12 +536,17 @@ class BatchNormLayer(Layer):
                 # test_inception_gate.py)
                 scale = slope * jax.lax.rsqrt(var + self.eps)
                 shift = bias - mean * scale
-                out = x * scale.astype(x.dtype) + shift.astype(x.dtype)
+                out = self._apply(x, scale, shift)
             else:
                 xhat = (x - mean) * jax.lax.rsqrt(var + self.eps)
                 out = (xhat * slope + bias).astype(x.dtype)
+                if self.fuse_relu:
+                    out = jax.nn.relu(out)
             if self.moving_avg:
                 m = self.bn_momentum
+                if layout is not None:    # state stays logical
+                    mean, var = take_valid(mean, layout), \
+                        take_valid(var, layout)
                 state = dict(
                     state,
                     running_exp=state["running_exp"] * m + mean * (1 - m),
@@ -483,7 +554,13 @@ class BatchNormLayer(Layer):
             return [out], state
         if self.moving_avg:
             mean, var = state["running_exp"], state["running_var"]
+            if layout is not None:        # scatter to physical (pads 0)
+                mean = pad_channel_vec(mean, layout)
+                var = pad_channel_vec(var, layout)
         else:
             mean, var = self._moments(x, mask)
         scale = slope * jax.lax.rsqrt(var + self.eps)
-        return [(x * scale + (bias - mean * scale)).astype(x.dtype)], state
+        out = (x * scale + (bias - mean * scale)).astype(x.dtype)
+        if self.fuse_relu:
+            out = jax.nn.relu(out)
+        return [out], state

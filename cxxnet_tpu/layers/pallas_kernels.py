@@ -242,6 +242,86 @@ def relu_max_pool_applicable(shape, param) -> bool:
             and param.kernel_height > 1)
 
 
+# --------------------------------------------------- fused BN epilogue
+
+def _bn_apply_kernel(relu: bool, x_ref, s_ref, t_ref, o_ref):
+    """One block: y = x * scale + shift (+ relu), scale/shift per
+    channel applied in the block's compute dtype — the same arithmetic
+    as the bn_fold_affine jnp path, so pairtest divergence is zero."""
+    x = x_ref[...]
+    y = x * s_ref[...].astype(x.dtype) + t_ref[...].astype(x.dtype)
+    if relu:
+        y = jnp.maximum(y, 0)
+    o_ref[...] = y
+
+
+def _bn_rows(h: int, w: int, c: int, itemsize: int) -> int:
+    """Rows per block so in+out blocks stay well inside scoped VMEM
+    (Mosaic pads W to the sublane multiple and C to 128 lanes)."""
+    padded_row = _pad_to(w, 32 // itemsize) * _pad_to(c, 128) * itemsize
+    rows = max(1, (4 * 1024 * 1024) // (padded_row * 4))
+    while h % rows:                       # blocks must tile H exactly
+        rows -= 1
+    return rows
+
+
+def _bn_apply_call(x: jnp.ndarray, scale: jnp.ndarray,
+                   shift: jnp.ndarray, relu: bool) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+
+    mat = x.ndim == 2
+    x4 = x[:, None, None, :] if mat else x
+    b, h, w, c = x4.shape
+    rows = _bn_rows(h, w, c, x4.dtype.itemsize)
+    # per-channel params as (1, c) blocks: 2-D tiles keep Mosaic on its
+    # native (sublane, lane) layout
+    y = pl.pallas_call(
+        partial(_bn_apply_kernel, relu),
+        grid=(b, h // rows),
+        in_specs=[
+            pl.BlockSpec((1, rows, w, c), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, c), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, c), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, rows, w, c),
+                               lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, c), x4.dtype),
+        interpret=_interpret(),
+    )(x4, scale[None, :], shift[None, :])
+    return y[:, 0, 0, :] if mat else y
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def bn_apply(x: jnp.ndarray, scale: jnp.ndarray, shift: jnp.ndarray,
+             relu: bool = False) -> jnp.ndarray:
+    """Fused BN epilogue: ``relu?(x * scale + shift)`` per channel as
+    ONE Pallas pass (NHWC or matrix nodes) — the hand-kernel answer to
+    Inception's ~30 per-layer BN+relu elementwise chains. scale/shift
+    are the already-folded per-channel factors (bn_fold_affine form);
+    the moments stay outside so autodiff composes through them."""
+    return _bn_apply_call(x, scale, shift, relu)
+
+
+def _bn_apply_vjp_fwd(x, scale, shift, relu):
+    y = _bn_apply_call(x, scale, shift, relu)
+    return y, (x, scale, y)
+
+
+def _bn_apply_vjp_bwd(relu, res, dy):
+    x, scale, y = res
+    dym = jnp.where(y > 0, dy, jnp.zeros_like(dy)) if relu else dy
+    # dx reuses the forward kernel (shift=0): one fused pass; the two
+    # channel reductions fuse in XLA and accumulate in f32
+    dx = _bn_apply_call(dym, scale, jnp.zeros_like(scale), False)
+    axes = tuple(range(x.ndim - 1))
+    dscale = jnp.sum((dym * x).astype(jnp.float32), axis=axes)
+    dshift = jnp.sum(dym.astype(jnp.float32), axis=axes)
+    return (dx, dscale.astype(scale.dtype), dshift.astype(scale.dtype))
+
+
+bn_apply.defvjp(_bn_apply_vjp_fwd, _bn_apply_vjp_bwd)
+
+
 class PallasFullConnectLayer(FullConnectLayer):
     """fullc with the matmul lowered through the Pallas kernel
     (config name ``pallas_fullc``); numerically identical to ``fullc``

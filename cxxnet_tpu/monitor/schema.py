@@ -39,6 +39,12 @@ REQUIRED: Dict[str, tuple] = {
                  "batches", "h2d_ms", "consumer_wait_ms"),
     # one-time AOT compile window (precompile = 1)
     "precompile": ("wall_ms", "programs"),
+    # static per-model records (emitted once per init/monitor attach):
+    # analytic FLOPs for MFU math + the layout/fusion pass decisions
+    "model_info": ("flops_per_example", "train_flops_per_example",
+                   "params", "layers"),
+    "layout": ("channel_pad", "layers_padded", "input_layout",
+               "bn_fuse_relu", "bn_fold_eval_pairs"),
     "eval": ("round", "name", "metrics"),
     "round_end": ("round", "examples", "wall_s", "examples_per_sec"),
     "trace_start": ("dir",),

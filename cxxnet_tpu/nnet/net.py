@@ -83,6 +83,100 @@ class FuncNet:
                             "node %d shape conflict: %s vs %s"
                             % (ni, prev, s))
                 self.node_shapes[ni] = s
+        self._fusion_passes()
+        from .layout import plan_channel_layouts
+        plan_channel_layouts(self)
+
+    # -- graph-level fusion passes ---------------------------------------
+
+    _BN_TYPES = ("batch_norm", "pallas_batch_norm")
+
+    def _net_flag(self, name: str, default: int = 0) -> int:
+        """Net-level knob from the global (default) layer config."""
+        val = default
+        for n, v in self.graph.defcfg:
+            if n == name:
+                val = int(v)
+        return val
+
+    def _fusion_passes(self) -> None:
+        """Epilogue fusion over the built graph.
+
+        ``bn_fuse_relu = 1``: a relu that is the SOLE consumer of a
+        batch-norm output runs inside the BN layer (one fused epilogue
+        — and one Pallas pass under bn_pallas) and the relu connection
+        becomes identity. Same math, exactly: relu(bn(x)).
+
+        ``bn_fold_eval = 1``: on the eval/pred path, a moving-average
+        batch_norm that solely consumes a conv's output folds its
+        running-stats scale/shift into the conv weights (w*scale is a
+        small per-out-channel multiply); the BN connection runs as
+        identity. Training is untouched — running stats keep updating
+        from batch moments. Parity is pinned by tests (reassociation-
+        level rounding only: the scale multiplies the weight before
+        the contraction instead of the output after it).
+
+        Both fusions change what INTERIOR nodes hold (the BN output
+        node carries the post-relu value; at eval the conv output node
+        carries the folded conv+BN value) — extraction or metrics
+        bound to those interior nodes read the fused values. Logical
+        net outputs are identical; the knobs are opt-in.
+        """
+        g = self.graph
+        self._identity_layers = set()     # relus folded into their BN
+        self._fold_pairs = {}             # conv li -> bn li (eval fold)
+        self._fold_bns = set()
+        self._bn_fold_eval = bool(self._net_flag("bn_fold_eval"))
+        consumers = g.node_consumers()
+        # a SHARED layer reuses its primary's object: mutating the
+        # primary (fuse_relu) would drag the fusion to every share
+        # site, whose consumers may not be relus — exclude them
+        shared_primaries = set(info.primary_layer_index
+                               for info in g.layers
+                               if info.type == "share")
+        if self._net_flag("bn_fuse_relu"):
+            for li, info in enumerate(g.layers):
+                if info.type not in self._BN_TYPES + ("batch_norm_no_ma",):
+                    continue
+                if li in shared_primaries:
+                    continue
+                out = info.nindex_out[0]
+                cons = consumers.get(out, [])
+                if len(cons) != 1:
+                    continue
+                lj = cons[0]
+                if g.layers[lj].type == "relu":
+                    self.layer_objs[li].fuse_relu = True
+                    self._identity_layers.add(lj)
+        if self._net_flag("bn_fold_eval"):
+            for li, info in enumerate(g.layers):
+                if info.type != "conv":
+                    continue
+                out = info.nindex_out[0]
+                cons = consumers.get(out, [])
+                if len(cons) != 1:
+                    continue
+                lj = cons[0]
+                if (g.layers[lj].type in self._BN_TYPES
+                        and self.layer_objs[lj].moving_avg):
+                    self._fold_pairs[li] = lj
+                    self._fold_bns.add(lj)
+
+    def _fold_entries(self, params: Params, state: NetState,
+                      conv_li: int):
+        """Per-out-channel scale/shift the eval fold injects into a
+        conv's params (from its BN partner's running stats)."""
+        import jax.lax
+        bn_li = self._fold_pairs[conv_li]
+        bn = self.layer_objs[bn_li]
+        bkey = self.graph.layer_key(self.graph.param_layer_index(bn_li))
+        bp, bs = params[bkey], state[bkey]
+        scale = bp["wmat"] * jax.lax.rsqrt(bs["running_var"] + bn.eps)
+        shift = bp["bias"] - bs["running_exp"] * scale
+        out = {"_fold_scale": scale, "_fold_shift": shift}
+        if bn.fuse_relu:
+            out["_fold_relu"] = True
+        return out
 
     # -- init ------------------------------------------------------------
 
@@ -129,12 +223,29 @@ class FuncNet:
             nodes[1 + i] = extra[i]
         new_state: NetState = dict(state)
         loss_inputs: Dict[int, jnp.ndarray] = {}
+        fold_eval = self._bn_fold_eval and not is_train
         for li, info in enumerate(g.layers):
+            if li in self._identity_layers or (fold_eval
+                                               and li in self._fold_bns):
+                # epilogue already ran fused inside the producer (relu
+                # inside BN / BN inside the folded conv): pass through
+                v = nodes[info.nindex_in[0]]
+                for ni in info.nindex_out:
+                    nodes[ni] = v
+                continue
             layer = self.layer_objs[li]
             pkey = g.layer_key(g.param_layer_index(li))
             p = params.get(pkey, {})
             s = new_state.get(pkey, {})
-            ins = [nodes[ni] for ni in info.nindex_in]
+            if fold_eval and li in self._fold_pairs:
+                p = dict(p)
+                p.update(self._fold_entries(params, new_state, li))
+            if li in self._depad_layers:
+                # layout barrier: this layer sees logical channels
+                ins = [self.depad_node(ni, nodes[ni])
+                       for ni in info.nindex_in]
+            else:
+                ins = [nodes[ni] for ni in info.nindex_in]
             lrng = (jax.random.fold_in(rng, li)
                     if rng is not None else None)
             if collect_logits and layer.is_loss:
@@ -179,10 +290,44 @@ class FuncNet:
                                  % layer.target)
             a, b = slices[layer.target]
             total = total + layer.loss_value(logit, labels[:, a:b], mask)
-        collected = [nodes[ni] for ni in collect_nodes]
+        collected = [self.depad_node(ni, nodes[ni])
+                     for ni in collect_nodes]
         return total, (new_state, collected)
 
     # -- utilities -------------------------------------------------------
+
+    def depad_node(self, ni: int, v):
+        """Slice a node value back to its logical channels (identity
+        for plain nodes) — extraction, metrics and layout barriers all
+        read logical tensors."""
+        from .layout import is_padded, take_valid
+        lay = self.node_layouts[ni] if ni < len(self.node_layouts) \
+            else None
+        if v is None or not is_padded(lay):
+            return v
+        return take_valid(v, lay)
+
+    def analytic_flops_per_example(self) -> float:
+        """Analytic forward FLOPs per example (2*MACs over the logical
+        conv/dense contractions; a training step is ~3x — one forward
+        plus two backward GEMMs per contraction). XLA's own
+        cost_analysis undercounts fused TPU convolutions ~15x
+        (doc/perf_profile.md), so MFU telemetry uses this count."""
+        g = self.graph
+        total = 0
+        for li in range(len(g.layers)):
+            layer = self.layer_objs[li]
+            t = g.effective_type(li)
+            if t == "conv":
+                p = layer.param
+                out = layer.out_shapes[0]
+                total += (2 * p.kernel_height * p.kernel_width
+                          * (p.num_input_channel // p.num_group)
+                          * out.ch * out.y * out.x)
+            elif t in ("fullc", "pallas_fullc", "fixconn"):
+                p = layer.param
+                total += 2 * p.num_input_node * p.num_hidden
+        return float(total)
 
     def loss_layer_indices(self) -> List[int]:
         return [li for li, l in enumerate(self.layer_objs)

@@ -98,6 +98,13 @@ class NetTrainer:
         self.precompile_dtype = "float32"  # input dtype precompile()
         #                                  lowers for (uint8 pipelines
         #                                  set precompile_dtype=uint8)
+        self.input_layout = "none"       # rowmajor: pin the batch
+        #                                  input's device layout with
+        #                                  channels minor (lane dim) so
+        #                                  the compiler cannot pick the
+        #                                  batch-minor cliff layout;
+        #                                  applied through precompile's
+        #                                  AOT lowering + device_put
         self.sample_counter = 0          # within accumulation window
         self.update_counter = 0          # applied updates (schedule epoch)
         self.round = 0
@@ -165,6 +172,11 @@ class NetTrainer:
                     raise ValueError(
                         "precompile_dtype must be float32 or uint8")
                 self.precompile_dtype = val
+            if name == "input_layout":
+                if val not in ("none", "rowmajor"):
+                    raise ValueError(
+                        "input_layout must be none or rowmajor")
+                self.input_layout = val
             if name in ("shard_optimizer", "update_on_server"):
                 # update_on_server=1 meant "optimizer state lives off the
                 # workers" (nnet_ps_server.cpp); here it means "optimizer
@@ -234,6 +246,7 @@ class NetTrainer:
         self._build_steps()
         self._put_all()
         self._initialized = True
+        self._emit_model_records()
 
     def _put_all(self) -> None:
         """Place params/state on the mesh with their shardings."""
@@ -258,6 +271,7 @@ class NetTrainer:
         self._aot = {}                   # rebuilt programs orphan any
         #                                  earlier AOT executables
         self._b_shard = batch_sharding(mesh)
+        self._probe_input_layout()
         self._repl = replicated(mesh)
         self._repl_leaf = self._repl
         self._p_shard = param_sharding(mesh, self.params,
@@ -275,24 +289,27 @@ class NetTrainer:
         metric_nodes = tuple(self._metric_nodes)
         update_period = self.update_period
         # stable (layer, tag) -> row in the packed hyper array; packing
-        # all per-step host scalars (lr/momentum/wd/epoch/step) into ONE
+        # all per-step host float scalars (lr/momentum/wd) into ONE
         # small array keeps host->device traffic to a single transfer
-        # per step (tunnel/PCIe latency dominates tiny transfers)
+        # per step (tunnel/PCIe latency dominates tiny transfers). The
+        # epoch rides as its own uint32 scalar beside it — a float32
+        # slot silently rounds integers past 2^24, skewing Adam's bias
+        # correction on long runs (same fix pattern as the RNG `step`)
         self._hyper_index = [(lk, tag)
                              for lk, tags in sorted(self.updaters.items())
                              for tag in sorted(tags)]
         self._base_key = jax.random.PRNGKey(self.seed + 1)
 
-        def unpack_hyper(hyper_arr, idx):
+        def unpack_hyper(hyper_arr, idx, epoch):
             return {"learning_rate": hyper_arr[idx, 0],
                     "momentum": hyper_arr[idx, 1],
                     "wd": hyper_arr[idx, 2],
-                    "epoch": hyper_arr[idx, 3]}
+                    "epoch": epoch}
 
         hyper_row = {(lk, tag): i
                      for i, (lk, tag) in enumerate(self._hyper_index)}
 
-        def apply_updates(params, opt_state, grads, hyper_arr):
+        def apply_updates(params, opt_state, grads, hyper_arr, epoch):
             new_p, new_o = {}, {}
             for lk, ptree in params.items():
                 new_p[lk], new_o[lk] = {}, {}
@@ -303,7 +320,8 @@ class NetTrainer:
                         g = g / float(update_period)
                     w2, s2 = upd.apply(
                         w, g, opt_state[lk][tag],
-                        unpack_hyper(hyper_arr, hyper_row[(lk, tag)]))
+                        unpack_hyper(hyper_arr, hyper_row[(lk, tag)],
+                                     epoch))
                     new_p[lk][tag] = w2
                     new_o[lk][tag] = s2
             return new_p, new_o
@@ -381,17 +399,18 @@ class NetTrainer:
         loss_fn = _wrap_loss_fn()
 
         def scan_step(params, opt_state, net_state, grad_acc,
-                      data, labels, mask, extra, hyper_row, do_up,
-                      step, base_key, collect):
+                      data, labels, mask, extra, hyper_row, epoch,
+                      do_up, step, base_key, collect):
             """The ONE train-step body all dispatch paths share
             (update / update_many / run_steps — a single definition so
             the math cannot drift between them). do_up may be traced
             (scan windows) or a static bool (per-batch update); the
             hyper row is per-step so the LR/momentum schedule advances
-            inside scanned dispatches. ``step`` rides as its own uint32
-            scalar — packing it into the float32 hyper array silently
-            rounded past 2^24 steps, repeating dropout/insanity RNG
-            streams on long runs."""
+            inside scanned dispatches. ``step`` and ``epoch`` ride as
+            their own uint32 scalars — a float32 hyper-array slot
+            silently rounds past 2^24, repeating dropout/insanity RNG
+            streams (step) and skewing Adam's bias correction (epoch)
+            on long runs."""
             rng = jax.random.fold_in(base_key, step)
             (loss, (new_state, preds)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(
@@ -401,7 +420,8 @@ class NetTrainer:
                 else []
             if update_period == 1:
                 params, opt_state = apply_updates(
-                    params, opt_state, _grad_f32(grads), hyper_row)
+                    params, opt_state, _grad_f32(grads), hyper_row,
+                    epoch)
                 return (params, opt_state, new_state, grad_acc, loss,
                         preds)
             # accumulate in f32 regardless of gradient dtype
@@ -409,7 +429,7 @@ class NetTrainer:
 
             def do_apply(args):
                 p, o, acc = args
-                p2, o2 = apply_updates(p, o, acc, hyper_row)
+                p2, o2 = apply_updates(p, o, acc, hyper_row, epoch)
                 return p2, o2, _tree_zeros_like(acc)
 
             params, opt_state, grad_acc = jax.lax.cond(
@@ -418,11 +438,11 @@ class NetTrainer:
             return params, opt_state, new_state, grad_acc, loss, preds
 
         def train_step(params, opt_state, net_state, grad_acc,
-                       data, labels, mask, extra, hyper_arr, step,
-                       base_key, do_update):
+                       data, labels, mask, extra, hyper_arr, epoch,
+                       step, base_key, do_update):
             return scan_step(params, opt_state, net_state, grad_acc,
                              data, labels, mask, extra, hyper_arr,
-                             do_update, step, base_key, True)
+                             epoch, do_update, step, base_key, True)
 
         donate = (0, 1, 3) if update_period > 1 else (0, 1)
         # pin output shardings: without this, GSPMD propagation from the
@@ -438,29 +458,35 @@ class NetTrainer:
                                    static_argnames=("do_update",),
                                    out_shardings=out_shardings)
 
-        def multi_step(params, opt_state, net_state, data, labels, mask,
-                       extra, hyper_k, step, base_key):
-            """n_steps full update steps in ONE dispatch (lax.scan over
-            the same resident batch) — host dispatch latency amortizes
-            to zero. hyper_k is (n_steps, n_updaters, 4): the schedule
-            advances per step in-scan."""
+        def multi_step(params, opt_state, net_state, grad_acc, data,
+                       labels, mask, extra, hyper_k, epoch_k, do_up_k,
+                       step, base_key):
+            """n_steps train steps in ONE dispatch (lax.scan over the
+            same resident batch) — host dispatch latency amortizes to
+            zero. hyper_k is (n_steps, n_updaters, 3): the schedule
+            advances per step in-scan; epoch_k/do_up_k carry the exact
+            uint32 epochs and the accumulation-window apply flags, so
+            ``update_period > 1`` closes its windows in-scan exactly
+            like the per-batch dispatch path."""
             def body(carry, xs):
-                p, o, s = carry
-                hyper_i, i = xs
-                p, o, s, _, loss, _ = scan_step(
-                    p, o, s, None, data, labels, mask, extra, hyper_i,
-                    True, step + i, base_key, False)
-                return (p, o, s), loss
+                p, o, s, acc = carry
+                hyper_i, epoch_i, do_up, i = xs
+                p, o, s, acc, loss, _ = scan_step(
+                    p, o, s, acc, data, labels, mask, extra, hyper_i,
+                    epoch_i, do_up, step + i, base_key, False)
+                return (p, o, s, acc), loss
             n = hyper_k.shape[0]
-            (params, opt_state, net_state), losses = jax.lax.scan(
-                body, (params, opt_state, net_state),
-                (hyper_k, jnp.arange(n, dtype=jnp.uint32)))
-            return params, opt_state, net_state, losses[-1]
+            carry, losses = jax.lax.scan(
+                body, (params, opt_state, net_state, grad_acc),
+                (hyper_k, epoch_k, do_up_k,
+                 jnp.arange(n, dtype=jnp.uint32)))
+            params, opt_state, net_state, grad_acc = carry
+            return params, opt_state, net_state, grad_acc, losses[-1]
 
         self._multi_step = jax.jit(
-            multi_step, donate_argnums=(0, 1),
+            multi_step, donate_argnums=donate,
             out_shardings=(self._p_shard, self._o_shard, ns_shard,
-                           self._repl))
+                           acc_shard, self._repl))
 
         # K-batch window sharding: leading axis = scan step, batch rows
         # sharded on 'data' as usual
@@ -470,22 +496,24 @@ class NetTrainer:
 
         def many_step(params, opt_state, net_state, grad_acc,
                       data_k, labels_k, mask_k, extra_k, hyper_k,
-                      do_up_k, step, base_key, collect):
+                      epoch_k, do_up_k, step, base_key, collect):
             """K REAL batches in one dispatch: scan over the stacked
-            window. Schedule-correct (per-step hyper rows) and
-            update_period-correct (traced apply flags)."""
+            window. Schedule-correct (per-step hyper rows + exact
+            uint32 epochs) and update_period-correct (traced apply
+            flags)."""
             def body(carry, xs):
                 p, o, s, acc = carry
-                data, labels, mask, extra, hyper_i, do_up, i = xs
+                (data, labels, mask, extra, hyper_i, epoch_i, do_up,
+                 i) = xs
                 p, o, s, acc, loss, preds = scan_step(
                     p, o, s, acc, data, labels, mask, extra, hyper_i,
-                    do_up, step + i, base_key, collect)
+                    epoch_i, do_up, step + i, base_key, collect)
                 return (p, o, s, acc), (loss, preds)
             K = hyper_k.shape[0]
             carry, (losses, preds_k) = jax.lax.scan(
                 body, (params, opt_state, net_state, grad_acc),
-                (data_k, labels_k, mask_k, extra_k, hyper_k, do_up_k,
-                 jnp.arange(K, dtype=jnp.uint32)))
+                (data_k, labels_k, mask_k, extra_k, hyper_k, epoch_k,
+                 do_up_k, jnp.arange(K, dtype=jnp.uint32)))
             params, opt_state, net_state, grad_acc = carry
             return (params, opt_state, net_state, grad_acc, losses[-1],
                     preds_k)
@@ -502,12 +530,58 @@ class NetTrainer:
                                           extra=extra,
                                           is_train=False, rng=None,
                                           mask=mask)
-            # metrics/extraction read f32 regardless of compute dtype
-            return [node_vals[i].astype(jnp.float32)
+            # metrics/extraction read f32 LOGICAL tensors regardless of
+            # compute dtype / channel padding
+            return [net.depad_node(i, node_vals[i]).astype(jnp.float32)
                     for i in nodes_wanted]
 
         self._pred_step = jax.jit(pred_step,
                                   static_argnames=("nodes_wanted",))
+
+    def _probe_input_layout(self) -> None:
+        """input_layout = rowmajor support probe: a tiny device_put
+        with an explicit major-to-minor layout. Unsupported backends /
+        jax builds fall back to unpinned with one warning — the knob
+        must never break a run, only bias the compiler away from the
+        batch-minor cliff layout (doc/perf_profile.md: batch 160 put
+        the batch on the 128-lane minor dim, 5,082 -> 3,088 img/s)."""
+        self._layout_cls = None
+        if self.input_layout != "rowmajor":
+            return
+        if jax.process_count() > 1:
+            # multi-process batches come through
+            # make_array_from_process_local_data, which takes no layout
+            # — an AOT program lowered with a pinned input layout would
+            # then mismatch every dispatched array. Pin single-process
+            # only.
+            from ..monitor import warn_once
+            warn_once("input_layout_multiprocess",
+                      "input_layout=rowmajor is single-process only; "
+                      "inputs stay unpinned under multi-process dp")
+            return
+        try:
+            from jax.experimental.layout import (DeviceLocalLayout,
+                                                 Layout)
+            probe = jax.device_put(
+                np.zeros((2, 2, 2, 2), np.float32),
+                Layout(DeviceLocalLayout(major_to_minor=(0, 1, 2, 3)),
+                       self._b_shard))
+            jax.block_until_ready(probe)
+            self._layout_cls = (DeviceLocalLayout, Layout)
+        except Exception as e:
+            from ..monitor import warn_once
+            warn_once("input_layout_unsupported",
+                      "input_layout=rowmajor is not supported by this "
+                      "backend/jax build (%s); inputs stay unpinned"
+                      % e)
+
+    def _pin_layout(self, sharding, ndim: int):
+        """Row-major (channels-minor) layout pin for a batch input, or
+        the plain sharding when pinning is off/unsupported."""
+        if self._layout_cls is None or ndim < 4:
+            return sharding
+        dll, layout = self._layout_cls
+        return layout(dll(major_to_minor=tuple(range(ndim))), sharding)
 
     def _call_step(self, kind, sig, jit_fn, args, **static_kw):
         """Dispatch one program: the AOT executable when precompile
@@ -553,7 +627,8 @@ class NetTrainer:
         except Exception:
             pass
 
-    def precompile(self, window: int = 1, n_steps: int = 0) -> int:
+    def precompile(self, window: int = 1, n_steps: int = 0,
+                   per_batch: bool = True) -> int:
         """AOT-compile the dispatch programs for the shapes this run
         will use, before round 0 touches the device.
 
@@ -573,7 +648,12 @@ class NetTrainer:
         ``extra_data`` inputs and eval iterators with a different
         batch_size fall back to the jit path for those dispatches —
         precompile never changes results, only when compilation
-        happens. Returns the number of programs compiled."""
+        happens. ``per_batch=False`` compiles ONLY the ``run_steps``
+        program (the bench capture path — no wasted minutes on update/
+        pred variants the capture never dispatches). With
+        ``input_layout = rowmajor`` the lowered programs pin the batch
+        input's device layout channels-minor. Returns the number of
+        programs compiled."""
         assert self._initialized, "call init_model/load_model first"
         from ..io.data import inst_array_shape
         t_start = time.perf_counter()
@@ -595,10 +675,12 @@ class NetTrainer:
                 return jax.ShapeDtypeStruct(shape, dt)
             return jax.ShapeDtypeStruct(shape, dt, sharding=sharding)
 
-        data_s = sds(data_shape, dtype, self._b_shard)
+        data_s = sds(data_shape, dtype,
+                     self._pin_layout(self._b_shard, len(data_shape)))
         labels_s = sds(label_shape, np.float32, self._b_shard)
-        hyper_s = sds((len(self._hyper_index), 4), np.float32)
+        hyper_s = sds((len(self._hyper_index), 3), np.float32)
         step_s = sds((), np.uint32)
+        epoch_s = sds((), np.uint32)
         # the None-mask specialization only exists single-process
         # (multi-process dp always materializes the mask — see _mask)
         mask_variants = [None, sds((n,), np.float32, self._b_shard)]
@@ -609,7 +691,7 @@ class NetTrainer:
         dt_str = str(dtype)
         programs = []                    # (key, lower_thunk)
 
-        for mask_v in mask_variants:
+        for mask_v in (mask_variants if per_batch else []):
             for du in do_up_variants:
                 key = ("update", data_shape, dt_str, label_shape,
                        mask_v is None, 0, bool(du))
@@ -618,7 +700,7 @@ class NetTrainer:
                                      self.params, self.opt_state,
                                      self.net_state, self.grad_acc,
                                      data_s, labels_s, m, (), hyper_s,
-                                     step_s, self._base_key,
+                                     epoch_s, step_s, self._base_key,
                                      do_update=d)))
             if window > 1:
                 K = int(window)
@@ -627,8 +709,9 @@ class NetTrainer:
                                  self._kb_shard)
                 mask_k = None if mask_v is None \
                     else sds((K, n), np.float32, self._kb_shard)
-                hyper_k_s = sds((K, len(self._hyper_index), 4),
+                hyper_k_s = sds((K, len(self._hyper_index), 3),
                                 np.float32)
+                epoch_k_s = sds((K,), np.uint32)
                 do_up_s = sds((K,), np.bool_)
                 collect = bool(self.eval_train and self._metrics.evals)
                 key = ("update_many", (K,) + data_shape, dt_str,
@@ -636,23 +719,14 @@ class NetTrainer:
                        collect)
                 programs.append((key, lambda mk=mask_k, c=collect,
                                  ds=data_k_s, ls=labels_k_s,
-                                 hs=hyper_k_s, us=do_up_s:
+                                 hs=hyper_k_s, es=epoch_k_s,
+                                 us=do_up_s:
                                  self._many_step.lower(
                                      self.params, self.opt_state,
                                      self.net_state, self.grad_acc,
-                                     ds, ls, mk, (), hs, us, step_s,
-                                     self._base_key, collect=c)))
-            if n_steps > 0:
-                hyper_k_s = sds((int(n_steps), len(self._hyper_index),
-                                 4), np.float32)
-                key = ("run_steps", data_shape, dt_str, label_shape,
-                       mask_v is None, 0, int(n_steps))
-                programs.append((key, lambda m=mask_v, hs=hyper_k_s:
-                                 self._multi_step.lower(
-                                     self.params, self.opt_state,
-                                     self.net_state, data_s, labels_s,
-                                     m, (), hs, step_s,
-                                     self._base_key)))
+                                     ds, ls, mk, (), hs, es, us,
+                                     step_s, self._base_key,
+                                     collect=c)))
             if self._metric_nodes:
                 nodes = tuple(self._metric_nodes)
                 key = ("pred", data_shape, dt_str, mask_v is None, 0,
@@ -662,6 +736,28 @@ class NetTrainer:
                                      self.params, self.net_state,
                                      data_s, m, (),
                                      nodes_wanted=nw)))
+
+        if n_steps > 0:
+            # run_steps is the bench/test_skipread mode: its mask
+            # variant is known up front (None single-process, the
+            # materialized mask under multi-process dp), so exactly ONE
+            # program compiles — no wasted minutes on the other variant
+            mask_rs = None if jax.process_count() == 1 \
+                else mask_variants[0]
+            ns = int(n_steps)
+            hyper_k_s = sds((ns, len(self._hyper_index), 3),
+                            np.float32)
+            epoch_k_s = sds((ns,), np.uint32)
+            do_up_k_s = sds((ns,), np.bool_)
+            key = ("run_steps", data_shape, dt_str, label_shape,
+                   mask_rs is None, 0, ns)
+            programs.append((key, lambda m=mask_rs, hs=hyper_k_s,
+                             es=epoch_k_s, us=do_up_k_s:
+                             self._multi_step.lower(
+                                 self.params, self.opt_state,
+                                 self.net_state, self.grad_acc,
+                                 data_s, labels_s, m, (), hs, es,
+                                 us, step_s, self._base_key)))
 
         compiled = 0
         for key, thunk in programs:
@@ -696,16 +792,26 @@ class NetTrainer:
     # -- hyper-params per step ------------------------------------------
 
     def _hyper(self, epoch: Optional[int] = None) -> np.ndarray:
-        """Packed (n_updaters, 4) array: lr, momentum, wd, epoch."""
+        """Packed (n_updaters, 3) array: lr, momentum, wd. The epoch is
+        NOT packed here — a float32 slot rounds integers past 2^24, so
+        it rides separately as an exact uint32 (see _epoch_u32)."""
         if epoch is None:
             epoch = self.update_counter
-        arr = np.zeros((len(self._hyper_index), 4), np.float32)
+        arr = np.zeros((len(self._hyper_index), 3), np.float32)
         for i, (lk, tag) in enumerate(self._hyper_index):
             upd = self.updaters[lk][tag]
             upd.param.schedule_epoch(epoch)
             arr[i] = (upd.param.learning_rate, upd.param.momentum,
-                      upd.param.wd, epoch)
+                      upd.param.wd)
         return arr
+
+    def _epoch_u32(self, epoch: Optional[int] = None) -> np.uint32:
+        """Exact device-side epoch (applied-update counter) for Adam's
+        bias correction — uint32, the same fix pattern as the RNG
+        ``step`` scalar."""
+        if epoch is None:
+            epoch = self.update_counter
+        return np.uint32(epoch)
 
     def _step_scalar(self) -> np.uint32:
         """Global sample-step counter for RNG folding (exact uint32; a
@@ -767,7 +873,9 @@ class NetTrainer:
             arr = np.asarray(arr, np.float32)
         if jax.process_count() > 1:
             return jax.make_array_from_process_local_data(sharding, arr)
-        return jax.device_put(arr, sharding)
+        # spatial batches take the row-major layout pin (channels on
+        # the minor/lane dim) when input_layout=rowmajor is active
+        return jax.device_put(arr, self._pin_layout(sharding, arr.ndim))
 
     def _put_batch_array(self, x) -> jnp.ndarray:
         if isinstance(x, jax.Array) and x.sharding == self._b_shard:
@@ -853,6 +961,31 @@ class NetTrainer:
         documented in doc/observability.md). A None/disabled monitor
         leaves the step path untouched."""
         self._mon = mon
+        if self._initialized:
+            self._emit_model_records()
+
+    def _emit_model_records(self) -> None:
+        """Static per-model telemetry: analytic FLOPs (the MFU
+        denominator) and the layout/fusion pass decisions — schema-
+        validated so BENCH records and monitor streams carry the same
+        machine-readable perf context."""
+        if not self._mon_on():
+            return
+        net = self.net
+        n_params = sum(int(np.prod(w.shape))
+                       for pt in self.params.values()
+                       for w in pt.values())
+        fwd = net.analytic_flops_per_example()
+        self._mon.emit("model_info",
+                       flops_per_example=fwd,
+                       train_flops_per_example=3.0 * fwd,
+                       params=n_params,
+                       layers=len(net.graph.layers))
+        self._mon.emit("layout",
+                       input_layout=self.input_layout,
+                       bn_fuse_relu=len(net._identity_layers),
+                       bn_fold_eval_pairs=len(net._fold_pairs),
+                       **net.layout_summary)
 
     def _mon_on(self) -> bool:
         return self._mon is not None and self._mon.enabled
@@ -947,7 +1080,8 @@ class NetTrainer:
         out = self._call_step(
             "update", sig, self._train_step,
             (self.params, self.opt_state, self.net_state, self.grad_acc,
-             data, labels, mask, extra, hyper, step, self._base_key),
+             data, labels, mask, extra, hyper, self._epoch_u32(), step,
+             self._base_key),
             do_update=bool(do_update))
         (self.params, self.opt_state, self.net_state,
          self.grad_acc, loss, preds) = out
@@ -970,25 +1104,35 @@ class NetTrainer:
                                             nvalid))
 
     def run_steps(self, batch: DataBatch, n_steps: int) -> None:
-        """Run n_steps full update steps on one resident batch in a
-        single dispatch (steady-state throughput measurement — the
+        """Run n_steps train steps on one resident batch in a single
+        dispatch (steady-state throughput measurement — the
         test_skipread mode, iter_batch_proc-inl.hpp:21). The LR/momentum
         schedule advances per step in-scan via a per-step hyper array
         (reference applies ScheduleEpoch every update, updater/param.h:
-        96-117)."""
-        assert self._initialized and self.update_period == 1
+        96-117), and ``update_period > 1`` accumulation windows close
+        in-scan via traced apply flags — the reference's canonical
+        update_period=2 configs benchmark in this fused mode, equality-
+        tested against the per-batch dispatch path."""
+        assert self._initialized, "call init_model/load_model first"
         t0 = time.perf_counter() if self._mon_on() else 0.0
         data, labels, mask, extra = self._device_batch(batch)
-        hyper_k = np.stack([self._hyper(self.update_counter + i)
-                            for i in range(int(n_steps))])
         n = int(n_steps)
+        period = self.update_period
+        S, U = self.sample_counter, self.update_counter
+        epochs = [U + (S + i) // period for i in range(n)]
+        hyper_k = np.stack([self._hyper(e) for e in epochs])
+        epoch_k = np.asarray(epochs, np.uint32)
+        do_up_k = np.asarray([((S + i + 1) % period) == 0
+                              for i in range(n)])
         sig = (data.shape, str(data.dtype), labels.shape,
                mask is None, len(extra), n)
         out = self._call_step(
             "run_steps", sig, self._multi_step,
-            (self.params, self.opt_state, self.net_state, data, labels,
-             mask, extra, hyper_k, self._step_scalar(), self._base_key))
-        (self.params, self.opt_state, self.net_state, loss) = out
+            (self.params, self.opt_state, self.net_state, self.grad_acc,
+             data, labels, mask, extra, hyper_k, epoch_k, do_up_k,
+             self._step_scalar(), self._base_key))
+        (self.params, self.opt_state, self.net_state, self.grad_acc,
+         loss) = out
         self._last_loss = loss
         ex = (self._local_batch_size(batch) - batch.num_batch_padd) * n
         self._count_examples(ex)
@@ -998,7 +1142,8 @@ class NetTrainer:
             self._emit_step("run_steps", n, ex, wall, sig,
                             float(hyper_k[0, 0, 0]) if hyper_k.size
                             else 0.0)
-        self.update_counter += n_steps
+        self.update_counter = U + (S + n) // period
+        self.sample_counter = (S + n) % period
 
     def update_many(self, batches: Sequence[DataBatch]) -> None:
         """Train on K real batches in ONE jitted dispatch: host dispatch
@@ -1019,8 +1164,9 @@ class NetTrainer:
         t0 = time.perf_counter() if self._mon_on() else 0.0
         period = self.update_period
         S, U = self.sample_counter, self.update_counter
-        hyper_k = np.stack([self._hyper(U + (S + i) // period)
-                            for i in range(K)])
+        epochs = [U + (S + i) // period for i in range(K)]
+        hyper_k = np.stack([self._hyper(e) for e in epochs])
+        epoch_k = np.asarray(epochs, np.uint32)
         do_up = np.asarray([((S + i + 1) % period) == 0
                             for i in range(K)])
         step0 = self._step_scalar()
@@ -1044,8 +1190,8 @@ class NetTrainer:
         out = self._call_step(
             "update_many", sig, self._many_step,
             (self.params, self.opt_state, self.net_state, self.grad_acc,
-             data_k, labels_k, mask_k, extra_k, hyper_k, do_up, step0,
-             self._base_key),
+             data_k, labels_k, mask_k, extra_k, hyper_k, epoch_k, do_up,
+             step0, self._base_key),
             collect=collect)
         (self.params, self.opt_state, self.net_state, self.grad_acc,
          loss, preds_k) = out

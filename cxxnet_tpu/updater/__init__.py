@@ -107,9 +107,16 @@ class AdamUpdater:
             g = _clip_nan(g, p.clip_gradient)
         if p.wd > 0.0:
             g = g - p.wd * w        # reference sign, adam_updater:80
-        epoch = hyper["epoch"]
-        fix1 = 1.0 - jnp.power(1.0 - p.decay1, epoch + 1.0)
-        fix2 = 1.0 - jnp.power(1.0 - p.decay2, epoch + 1.0)
+        epoch = jnp.asarray(hyper["epoch"])
+        # epoch arrives as an exact uint32 (the trainer's hyper-array
+        # float32 slot rounded past 2^24); add 1 in integer space
+        # before the float conversion the pow needs
+        if jnp.issubdtype(epoch.dtype, jnp.integer):
+            t = (epoch + 1).astype(jnp.float32)
+        else:
+            t = epoch + 1.0
+        fix1 = 1.0 - jnp.power(1.0 - p.decay1, t)
+        fix2 = 1.0 - jnp.power(1.0 - p.decay2, t)
         lr_t = p.base_lr * jnp.sqrt(fix2) / fix1
         m1 = state["m_w1"] + p.decay1 * (g - state["m_w1"])
         m2 = state["m_w2"] + p.decay2 * (g * g - state["m_w2"])

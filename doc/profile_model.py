@@ -83,10 +83,13 @@ def profile(model: str = "inception_bn", batch: int = 0,
     flops_per_step = None
     try:
         data, labels, mask, extra = t._device_batch(b)
+        hyper_k = np.stack([t._hyper(i) for i in range(steps)])
+        epoch_k = np.arange(steps, dtype=np.uint32)
+        do_up_k = np.ones((steps,), np.bool_)
         ca = t._multi_step.lower(
-            t.params, t.opt_state, t.net_state, data, labels, mask,
-            extra, t._hyper(), t._step_scalar(), t._base_key,
-            n_steps=steps).compile().cost_analysis()
+            t.params, t.opt_state, t.net_state, t.grad_acc, data,
+            labels, mask, extra, hyper_k, epoch_k, do_up_k,
+            t._step_scalar(), t._base_key).compile().cost_analysis()
         if ca and "flops" in ca:
             flops_per_step = float(ca["flops"]) / steps
     except Exception as e:

@@ -58,6 +58,66 @@ def _collect(params, n=200, size=24, target=16):
     return np.stack(out)
 
 
+def test_affine_composition_independent_oracle():
+    """Independent-oracle pin for the rotate+shear+aspect+scale warp:
+    the augmenter builds ONE fused closed-form matrix
+    (image_augmenter-inl.hpp:75-120); here the same warp is rebuilt
+    from independently composed ELEMENTARY matrices
+    (Shear @ AspectScale @ Rotation, centering translation computed
+    separately) and applied through cv2 directly. Matrix-composition
+    ORDER is exactly where ports diverge — internal-invariant tests
+    would pass a transposed or reversed composition; this one cannot."""
+    import cv2
+    size, target = 32, 16
+    img = _img(size)
+    aug = AugmentAdapter(Repeat(img, 3))
+    aug.set_param("input_shape", "3,%d,%d" % (target, target))
+    aug.set_param("max_rotate_angle", "30")
+    aug.set_param("max_shear_ratio", "0.2")
+    aug.set_param("max_aspect_ratio", "0.15")
+    aug.set_param("min_random_scale", "0.9")
+    aug.set_param("max_random_scale", "1.2")
+    aug.set_param("fill_value", "0")
+    aug.init()
+    assert aug.next()
+    inst = aug.value()
+    got = np.asarray(inst.data)
+
+    # independent oracle: replay the SAME per-instance RNG stream in
+    # the documented draw order (angle, shear, scale, ratio, then the
+    # crop), but build the warp from elementary matrices
+    rng = aug._inst_rng(inst.index)
+    angle = rng.uniform(-30.0, 30.0)
+    shear = rng.uniform(-0.2, 0.2)
+    scale = rng.uniform(0.9, 1.2)
+    ratio = 1.0 + rng.uniform(-0.15, 0.15)
+    hs = 2.0 * scale / (1.0 + ratio)
+    ws = ratio * hs
+    rad = np.deg2rad(angle)
+    rot = np.array([[np.cos(rad), np.sin(rad)],
+                    [-np.sin(rad), np.cos(rad)]])
+    aspect_scale = np.diag([hs, ws])
+    shear_m = np.array([[1.0, shear], [0.0, 1.0]])
+    m2 = shear_m @ aspect_scale @ rot       # the composition under test
+    new_w = int(round(scale * size))
+    new_h = int(round(scale * size))
+    m = np.zeros((2, 3), np.float32)
+    m[:, :2] = m2
+    m[0, 2] = (new_w - (m[0, 0] * size + m[0, 1] * size)) / 2.0
+    m[1, 2] = (new_h - (m[1, 0] * size + m[1, 1] * size)) / 2.0
+    warped = cv2.warpAffine(img, m, (new_w, new_h),
+                            flags=cv2.INTER_LINEAR,
+                            borderMode=cv2.BORDER_CONSTANT,
+                            borderValue=(0, 0, 0))
+    # same RNG continues into the (center) crop; no mirror configured
+    ys = (new_h - target) // 2
+    xs = (new_w - target) // 2
+    expected = warped[ys:ys + target, xs:xs + target]
+    # tolerance covers the last-ulp reassociation between the fused
+    # closed-form matrix and the composed product (values are 0..200)
+    np.testing.assert_allclose(got, expected, atol=2e-2)
+
+
 def test_rotate_fixed_angle_deterministic():
     a = _collect([("rotate", "90")])
     b = _collect([("rotate", "90")])

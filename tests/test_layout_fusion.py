@@ -1,0 +1,388 @@
+"""Parity pins for the device-step optimization passes:
+
+- channel_pad (nnet/layout.py): channel-aligned training must be
+  BIT-EXACT in f32 against the unpadded program — padded channels are
+  provably-zero extensions, not math changes — including through
+  ch_concat, layout barriers, and extraction.
+- bn_fuse_relu: relu folded into the BN epilogue is the identical
+  function composition (bit-exact).
+- bn_fold_eval: BN running-stats scale/shift folded into the conv
+  weights for eval/pred — reassociation-level rounding only.
+- pallas_batch_norm (pallas_kernels.bn_apply): zero pairtest
+  divergence against the jnp folded path.
+- run_steps with update_period > 1: the scanned dispatch equals the
+  per-batch dispatch path across accumulation windows.
+- the uint32 epoch: exact past 2^24 where the old f32 hyper slot
+  rounded.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.layers import Shape3, create_layer
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+import bench
+
+
+CHAIN_CONF = """
+netconfig=start
+layer[+1:c1] = conv:cv1
+  nchannel = 6
+  kernel_size = 3
+layer[+1:b1] = batch_norm:bn1
+layer[+1:r1] = relu
+layer[+1:c2] = conv:cv2
+  nchannel = 5
+  kernel_size = 3
+layer[+1:b2] = batch_norm:bn2
+layer[+1:r2] = relu
+layer[+1] = flatten
+layer[+1:fc] = fullc:fc1
+  nhidden = 4
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,10,10
+batch_size = 8
+eta = 0.05
+momentum = 0.9
+metric = error
+"""
+
+# branchy net: ch_concat over unevenly-padded branches + a max-pool
+# branch, then an LRN (a layout BARRIER: channel-window sums would see
+# the pad gaps) before the head — exercises scatter/merge/de-pad
+CONCAT_CONF = """
+netconfig=start
+layer[+1:s] = conv:cv0
+  nchannel = 6
+  kernel_size = 3
+layer[s->a_c] = conv:cva
+  nchannel = 5
+  kernel_size = 1
+layer[a_c->a_b] = batch_norm:bna
+layer[a_b->a] = relu
+layer[s->b_c] = conv:cvb
+  nchannel = 3
+  kernel_size = 3
+  pad = 1
+layer[b_c->b_b] = batch_norm:bnb
+layer[b_b->b] = relu
+layer[s->p] = max_pooling
+  kernel_size = 3
+  stride = 1
+  pad = 1
+layer[a,b,p->cat] = ch_concat
+layer[+1:l] = lrn
+  local_size = 3
+layer[+1:c2] = conv:cv2
+  nchannel = 4
+  kernel_size = 3
+layer[+1] = flatten
+layer[+1:fc] = fullc:fc1
+  nhidden = 4
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,10,10
+batch_size = 8
+eta = 0.05
+momentum = 0.9
+metric = error
+"""
+
+
+def _data(seed=0, n=8, size=10, nclass=4):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(n, size, size, 3).astype(np.float32),
+            rng.randint(0, nclass, (n, 1)).astype(np.float32))
+
+
+def _train(conf, extra, size=10, steps=2, seed=0):
+    data, label = _data(seed, size=size)
+    t = NetTrainer(parse_config(conf) + list(extra))
+    t.init_model()
+    for _ in range(steps):
+        t.update(DataBatch(data=data, label=label))
+    return t
+
+
+def _assert_params(ta, tb, exact=True, rtol=0.0, atol=0.0):
+    for lk in ta.params:
+        for tag in ta.params[lk]:
+            a = np.asarray(ta.params[lk][tag])
+            b = np.asarray(tb.params[lk][tag])
+            if exact:
+                np.testing.assert_array_equal(
+                    a, b, err_msg="param %s:%s diverged" % (lk, tag))
+            else:
+                np.testing.assert_allclose(
+                    a, b, rtol=rtol, atol=atol,
+                    err_msg="param %s:%s diverged" % (lk, tag))
+
+
+def test_channel_pad_bitexact_training():
+    """channel_pad pads conv outputs with provably-zero channels: the
+    padded program's params after several updates are BIT-EXACT equal
+    to the unpadded program's (f32)."""
+    base = _train(CHAIN_CONF, [])
+    padded = _train(CHAIN_CONF, [("channel_pad", "8")])
+    assert padded.net.layout_summary["layers_padded"] > 0
+    _assert_params(base, padded, exact=True)
+
+
+def test_channel_pad_concat_barrier_and_extract():
+    """Through ch_concat (alignment-aware merged segments), a pooling
+    branch, and an LRN barrier (de-pad before channel-window sums) —
+    training stays bit-exact and extraction returns LOGICAL channels."""
+    base = _train(CONCAT_CONF, [], size=10)
+    padded = _train(CONCAT_CONF, [("channel_pad", "4")], size=10)
+    lay = padded.net.node_layouts[
+        padded.net.node_index_by_name("cat")]
+    assert len(lay) == 3 and any(p for _, p in lay)
+    assert padded.net._depad_layers        # the LRN barrier
+    _assert_params(base, padded, exact=True)
+    data, label = _data(0, size=10)
+    b = DataBatch(data=data, label=label)
+    fa = base.extract_feature(b, "cat")
+    fb = padded.extract_feature(b, "cat")
+    assert fa.shape == fb.shape            # logical channels (5+3+6)
+    assert fa.shape[-1] == 14
+    np.testing.assert_array_equal(fa, fb)
+    np.testing.assert_array_equal(base.predict(b), padded.predict(b))
+
+
+def test_bn_fuse_relu_bitexact():
+    base = _train(CHAIN_CONF, [])
+    fused = _train(CHAIN_CONF, [("bn_fuse_relu", "1")])
+    assert len(fused.net._identity_layers) == 2
+    _assert_params(base, fused, exact=True)
+
+
+SHARED_BN_CONF = """
+netconfig=start
+layer[+1:c1] = conv:cv1
+  nchannel = 4
+  kernel_size = 3
+layer[+1:b1] = batch_norm:bnS
+layer[+1:r1] = relu
+layer[0->e] = conv:cv2
+  nchannel = 4
+  kernel_size = 3
+layer[e->f] = share[bnS]
+layer[f->g] = flatten
+layer[r1->h] = flatten
+layer[g,h->cat] = concat
+layer[+1:fc] = fullc:fc1
+  nhidden = 4
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 3,10,10
+batch_size = 8
+eta = 0.05
+momentum = 0.9
+metric = error
+"""
+
+
+def test_bn_fuse_relu_skips_shared_primaries():
+    """A shared BN reuses the primary layer OBJECT: fusing the relu
+    into the primary would drag the relu to the share site, whose
+    consumer here is a flatten — the pass must skip shared primaries
+    so the fused net stays bit-exact with the plain one."""
+    base = _train(SHARED_BN_CONF, [])
+    fused = _train(SHARED_BN_CONF, [("bn_fuse_relu", "1")])
+    assert not fused.net.layer_objs[1].fuse_relu
+    _assert_params(base, fused, exact=True)
+
+
+def test_bn_fold_eval_parity():
+    """Folding BN running stats into the conv weights for eval/pred:
+    same math modulo reassociation (the scale multiplies the weight
+    before the contraction instead of the output after it)."""
+    base = _train(CHAIN_CONF, [])
+    fold = _train(CHAIN_CONF, [("bn_fold_eval", "1")])
+    assert len(fold.net._fold_pairs) == 2
+    _assert_params(base, fold, exact=True)  # training untouched
+    data, label = _data(1)
+    b = DataBatch(data=data, label=label)
+    np.testing.assert_array_equal(base.predict(b), fold.predict(b))
+    fa = base.extract_feature(b, "b2")
+    fb = fold.extract_feature(b, "b2")
+    np.testing.assert_allclose(fa, fb, rtol=1e-4, atol=5e-5)
+
+
+def test_bn_fold_eval_with_fuse_relu_and_pad():
+    """All three knobs compose: folded conv applies the fused relu and
+    pads its output channels; eval output still matches the plain
+    program within rounding."""
+    extra = [("bn_fold_eval", "1"), ("bn_fuse_relu", "1"),
+             ("channel_pad", "8")]
+    base = _train(CHAIN_CONF, [])
+    opt = _train(CHAIN_CONF, extra)
+    data, label = _data(1)
+    b = DataBatch(data=data, label=label)
+    fa = base.extract_feature(b, "r2")
+    fb = opt.extract_feature(b, "r2")
+    assert fa.shape == fb.shape
+    np.testing.assert_allclose(fa, fb, rtol=1e-4, atol=5e-5)
+
+
+def test_pairtest_pallas_batch_norm_divergence_at_fma_level(rng):
+    """The Pallas fused BN epilogue against the jnp folded path inside
+    one pairtest connection: same formula, same operands — divergence
+    bounded at the FMA-contraction level (the XLA fusion may contract
+    x*scale+shift into an fma where the interpret-mode kernel keeps
+    separate mul/add; one rounding of an O(1) normalized tensor)."""
+    layer = create_layer("pairtest-batch_norm-pallas_batch_norm", [])
+    layer.infer_shape([Shape3(5, 6, 6)])
+    params = layer.init_params(jax.random.PRNGKey(0))
+    state = layer.init_state()
+    x = jnp.asarray(rng.randn(4, 6, 6, 5).astype(np.float32))
+    outs, new_state = layer.forward(params, state, [x], True, None,
+                                    mask=None)
+    assert float(new_state["pairtest:max_diff"]) < 1e-6
+
+    def f(p):
+        o, _ = layer.forward(p, state, [x], True, None, mask=None)
+        return jnp.sum(o[0] ** 2)
+
+    g = jax.grad(f)(params)
+    np.testing.assert_allclose(np.asarray(g["wmat"]),
+                               np.asarray(g["slave:wmat"]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g["bias"]),
+                               np.asarray(g["slave:bias"]), atol=1e-4)
+
+
+def test_pallas_bn_training_matches_jnp():
+    base = _train(CHAIN_CONF, [])
+    pl = _train(CHAIN_CONF, [("bn_pallas", "1"), ("bn_fuse_relu", "1")])
+    _assert_params(base, pl, exact=False, rtol=1e-3, atol=1e-5)
+
+
+def test_run_steps_update_period_matches_per_batch():
+    """run_steps now accepts update_period > 1: n scanned steps on one
+    resident batch equal n update() calls — accumulation windows close
+    in-scan, counters agree, including an odd tail (window left open
+    mid-period)."""
+    extra = [("update_period", "2"), ("eval_train", "0")]
+    data, label = _data(3)
+    ta = NetTrainer(parse_config(CHAIN_CONF) + extra)
+    tb = NetTrainer(parse_config(CHAIN_CONF) + extra)
+    ta.init_model()
+    tb.init_model()
+    b = DataBatch(data=data, label=label)
+    ba = DataBatch(data=ta._put_batch_array(data),
+                   label=ta._put_batch_array(label))
+    ta.run_steps(ba, 5)                   # 2.5 accumulation windows
+    for _ in range(5):
+        tb.update(b)
+    assert ta.update_counter == tb.update_counter == 2
+    assert ta.sample_counter == tb.sample_counter == 1
+    _assert_params(ta, tb, exact=False, rtol=1e-6, atol=1e-7)
+    # the open window closes identically on both paths
+    ta.run_steps(ba, 1)
+    tb.update(b)
+    assert ta.update_counter == tb.update_counter == 3
+    assert ta.sample_counter == tb.sample_counter == 0
+    _assert_params(ta, tb, exact=False, rtol=1e-6, atol=1e-7)
+
+
+def test_epoch_rides_exact_uint32():
+    """The applied-update counter reaches the device exactly: a float32
+    hyper slot rounds 2^24+1 to 2^24 (the old bug); the uint32 scalar
+    does not — and the packed hyper array no longer carries an epoch
+    column at all."""
+    t = NetTrainer(parse_config(CHAIN_CONF))
+    t.init_model()
+    t.update_counter = 2 ** 24 + 1
+    e = t._epoch_u32()
+    assert e.dtype == np.uint32
+    assert int(e) == 2 ** 24 + 1
+    assert int(np.float32(2 ** 24 + 1)) == 2 ** 24   # why f32 failed
+    assert t._hyper().shape[1] == 3
+
+
+def test_adam_bias_correction_integer_epoch(rng):
+    """AdamUpdater accepts the uint32 epoch and computes the same
+    bias-corrected step as with the float epoch at small t."""
+    from cxxnet_tpu.updater import create_updater
+    upd = create_updater("adam", "wmat", [("eta", "0.01")])
+    w = jnp.asarray(rng.randn(4, 3).astype(np.float32))
+    g = jnp.asarray(rng.randn(4, 3).astype(np.float32))
+    st = upd.init_state(w)
+    h32 = {"learning_rate": jnp.float32(0.01),
+           "momentum": jnp.float32(0.9), "wd": jnp.float32(0.0),
+           "epoch": jnp.float32(7)}
+    hu32 = dict(h32, epoch=jnp.uint32(7))
+    w1, _ = upd.apply(w, g, st, h32)
+    w2, _ = upd.apply(w, g, st, hu32)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w2),
+                               rtol=1e-7)
+
+
+# ---------------------------------------------------------------- bench
+
+def test_load_compare_record_single_model_keeps_spread(tmp_path):
+    f = tmp_path / "b.json"
+    f.write_text(json.dumps({"value": 20000.0, "spread": 1.4,
+                             "suspect": False}))
+    old = bench.load_compare_record(str(f))
+    assert old == {"alexnet": {"value": 20000.0, "spread": 1.4,
+                               "suspect": False}}
+    # the recorded spread governs tolerance (not the 1.2 floor)
+    out = bench.compare_models(old, {"alexnet": {"value": 15000.0,
+                                                 "spread": 1.0}})
+    assert out["alexnet"]["verdict"] == "ok"
+
+
+@pytest.mark.parametrize("value", [0.0, -3.0, float("nan"),
+                                   float("inf"), None, "20k"])
+def test_load_compare_record_rejects_corrupt_values(tmp_path, value):
+    f = tmp_path / "b.json"
+    f.write_text(json.dumps({"models": {"alexnet": {"value": value}}}))
+    with pytest.raises(ValueError, match="corrupt value"):
+        bench.load_compare_record(str(f))
+
+
+def test_compare_exit_codes(tmp_path, monkeypatch, capsys):
+    """--compare exits 1 on regression, 3 (distinct — argparse owns 2
+    for usage/corrupt-record errors) when any verdict is suspect: an
+    untrustworthy capture must not pass the gate."""
+    old = {"metric": "m", "value": 1000.0, "unit": "u",
+           "models": {m: {"value": 1000.0, "spread": 1.0,
+                          "suspect": False} for m in bench.MODELS}}
+    f = tmp_path / "old.json"
+    f.write_text(json.dumps(old))
+
+    def run(fake_capture):
+        monkeypatch.setattr(bench, "measure",
+                            lambda *a, **k: dict(fake_capture))
+        monkeypatch.setattr(bench, "measure_pipeline",
+                            lambda *a, **k: (_ for _ in ()).throw(
+                                RuntimeError("skipped")))
+        monkeypatch.setattr(sys, "argv",
+                            ["bench.py", "--compare", str(f)])
+        try:
+            bench.main()
+        except SystemExit as e:
+            return int(e.code or 0)
+        return 0
+
+    ok = {"value": 1001.0, "dt": [1.0], "spread": 1.0, "suspect": False,
+          "zero_recompiles": True, "flops_per_img": 0.0, "layout": {}}
+    assert run(ok) == 0
+    assert run(dict(ok, value=100.0)) == 1          # real regression
+    assert run(dict(ok, suspect=True)) == 3         # untrustworthy
+    capsys.readouterr()

@@ -181,6 +181,43 @@ def test_im2rec_spaced_paths(tmp_path):
 
 
 @pytest.mark.skipif(not _HAVE_TOOLS, reason="im2rec not built")
+def test_im2rec_numeric_first_token_spaced_path(tmp_path):
+    """A spaced path whose FIRST token is numeric ('2012 photos/x.jpg')
+    is ambiguous with an excess-labels row. When the assembled path
+    exists on disk it must pack (with a warning), not hard-fail; when
+    it does not, the error must mention the spaced-path case so the
+    workaround is discoverable."""
+    import cv2
+    d = tmp_path / "2012 photos"
+    d.mkdir()
+    rng = np.random.RandomState(0)
+    cv2.imwrite(str(d / "a.jpg"),
+                rng.randint(0, 255, (16, 16, 3), np.uint8))
+    lst = tmp_path / "img.lst"
+    lst.write_text("0\t1\t2012 photos/a.jpg\n")
+    rec = str(tmp_path / "num.rec")
+    p = subprocess.run([os.path.join(REPO, "bin/im2rec"),
+                        str(lst), str(tmp_path) + "/", rec],
+                       capture_output=True, text=True)
+    assert p.returncode == 0, p.stderr
+    assert "spaced path" in p.stderr
+    r = RecordIOReader(rec)
+    idx, label, payload = unpack_image_record(r.next_record())
+    assert (idx, label) == (0, 1.0)
+    assert cv2.imdecode(np.frombuffer(payload, np.uint8),
+                        cv2.IMREAD_COLOR) is not None
+
+    # missing file: still an error, now with the spaced-path hint
+    lst.write_text("0\t1\t2012 photos/missing.jpg\n")
+    p = subprocess.run([os.path.join(REPO, "bin/im2rec"),
+                        str(lst), str(tmp_path) + "/",
+                        str(tmp_path / "num2.rec")],
+                       capture_output=True, text=True)
+    assert p.returncode != 0
+    assert "spaced path" in p.stderr
+
+
+@pytest.mark.skipif(not _HAVE_TOOLS, reason="im2rec not built")
 def test_im2rec_resize(tmp_path):
     lst, root = _write_jpegs(tmp_path, n=4, size=40)
     rec = str(tmp_path / "r.rec")

@@ -130,11 +130,28 @@ int main(int argc, char *argv[]) {
     char *endp = nullptr;
     std::strtod(tok0.c_str(), &endp);
     if (endp != nullptr && *endp == '\0' && (ps >> trailing)) {
-      std::fprintf(stderr,
-                   "numeric path token %s followed by %s — does the "
-                   "list have more labels than label_width=%d?\n",
-                   tok0.c_str(), trailing.c_str(), label_width);
-      return 1;
+      // ambiguous row: could be excess labels OR a legitimate spaced
+      // path whose first component is numeric ("2012 photos/img.jpg").
+      // If the assembled path exists on disk it is clearly the latter
+      // — warn and pack it; only hard-reject when it does not resolve.
+      std::string probe = root + path;
+      std::ifstream exists(probe.c_str(), std::ios::binary);
+      if (exists.good()) {
+        std::fprintf(stderr,
+                     "warning: path %s starts with a numeric token but "
+                     "exists on disk — packing it as a spaced path\n",
+                     path.c_str());
+      } else {
+        std::fprintf(stderr,
+                     "numeric path token %s followed by %s — does the "
+                     "list have more labels than label_width=%d? (if "
+                     "this is a spaced path whose first directory is "
+                     "numeric, the file %s was not found under the "
+                     "image root)\n",
+                     tok0.c_str(), trailing.c_str(), label_width,
+                     probe.c_str());
+        return 1;
+      }
     }
     std::string full = root + path;
 
