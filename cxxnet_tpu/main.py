@@ -34,6 +34,25 @@ from .utils.stream import list_stream_dir, open_stream, uri_scheme
 
 _MODEL_RE = re.compile(r"^(\d{4})\.model\.npz$")
 
+# tasks that read data through the pred iterator (or its fallback)
+_PRED_TASKS = ("pred", "extract_feature", "extract", "pred_raw", "serve")
+
+# randomized-pipeline knobs neutralized when a pred-like task falls
+# back to the train data block: evaluation order must be the file
+# order and every example must go through the deterministic eval
+# transform (center crop / mean / scale stay — they define the input
+# distribution; the stochastic knobs do not)
+_PRED_NEUTRAL = (
+    ("shuffle", "0"), ("shuffle_chunk", "0"),
+    ("rand_crop", "0"), ("rand_mirror", "0"),
+    ("max_random_contrast", "0"), ("max_random_illumination", "0"),
+    ("max_rotate_angle", "0"), ("max_shear_ratio", "0"),
+    ("max_aspect_ratio", "0"),
+    ("min_random_scale", "1"), ("max_random_scale", "1"),
+    ("min_crop_size", "-1"), ("max_crop_size", "-1"),
+    ("rotate", "-1"), ("rotate_list", ""),
+)
+
 
 class LearnTask:
     def __init__(self) -> None:
@@ -229,6 +248,23 @@ class LearnTask:
                         for k, v in pairs]
 
             batch_cfg = _localize(batch_cfg)
+            if (self.task in _PRED_TASKS and not self.test_io
+                    and not any(b["kind"] == "pred" for b in blocks)):
+                # no 'pred =' block: these tasks fall back to the train
+                # data block, which is configured for training (shuffled,
+                # randomly augmented) — say so once, and neutralize the
+                # stochastic knobs so the output is deterministic and
+                # row-aligned with the source files
+                for b in blocks:
+                    if b["kind"] != "data":
+                        continue
+                    b["cfg"] = list(b["cfg"]) + list(_PRED_NEUTRAL)
+                    self._mon.warn_once(
+                        "pred_fallback_train_iter",
+                        "task=%s has no 'pred =' iterator block; "
+                        "falling back to the train data block %r with "
+                        "shuffle/augmentation disabled" %
+                        (self.task, b["name"]))
             for b in blocks:
                 it = create_iterator(_localize(b["cfg"]), batch_cfg)
                 it.init()
@@ -242,6 +278,10 @@ class LearnTask:
 
             if self.test_io:
                 return self._task_test_io(itr_train)
+
+            if self.task == "serve":
+                assert self.model_in, "task serve requires model_in"
+                return self._task_serve(cfg, pred_iter or itr_train)
 
             trainer = NetTrainer(cfg)
             if self.task in ("train", "finetune"):
@@ -422,6 +462,58 @@ class LearnTask:
             c = trainer.counters_snapshot()
             mon.emit("run_end", wall_s=time.time() - start,
                      steps=int(c["steps"]), examples=int(c["examples"]))
+        return 0
+
+    def _task_serve(self, cfg, itr) -> int:
+        """Long-lived concurrent predictor (doc/serving.md): load the
+        snapshot into a frozen bucketed engine behind the dynamic
+        batcher, then drive ``serve_clients`` threaded closed-loop
+        clients over the iterator's examples — a self-contained soak
+        that exercises the full concurrent path and emits the
+        ``serve_*`` telemetry records."""
+        assert itr is not None, "serve requires an iterator block"
+        assert world_size() == 1, "task=serve must run single-process"
+        from .serve import ServeSession, run_closed_loop
+        mon = self._mon
+        if mon.enabled:
+            mon.emit("run_start",
+                     **run_metadata("serve", self._cfg_stream))
+        session = ServeSession(cfg, model_path=self.model_in,
+                               monitor=mon)
+        try:
+            c = session.cfg
+            # example pool for the clients: enough valid rows that
+            # wrapping reuse stays fair, forced to a private float32
+            # copy (iterator ring buffers recycle their arrays)
+            want = max(256, c.clients * c.request_rows)
+            pool_parts, got = [], 0
+            for batch in itr:
+                n = batch.batch_size - batch.num_batch_padd
+                pool_parts.append(np.array(batch.data[:n], np.float32))
+                got += n
+                if got >= want:
+                    break
+            assert pool_parts, "serve: iterator produced no examples"
+            pool = np.concatenate(pool_parts, axis=0)
+            agg = run_closed_loop(session, pool, c.clients, c.requests,
+                                  c.request_rows)
+            summary = session.close()
+        finally:
+            # a failure between warmup and close must not leave the
+            # worker threads emitting into a sink run() is about to
+            # close (close is idempotent; no-op on the success path)
+            session.close(drain=False)
+        mon.line(
+            "serve: %d ok / %d busy / %d timeout / %d error requests "
+            "(%d rows) in %.2fs, p50 %.1f ms p99 %.1f ms, fill %.2f, "
+            "compiles after warmup %d"
+            % (agg["ok"], agg["busy"], agg["timeout"], agg["error"],
+               summary["rows"], agg["wall_s"],
+               summary["latency_p50_ms"], summary["latency_p99_ms"],
+               summary["fill_rate"], summary["compile_events"]))
+        if mon.enabled:
+            mon.emit("task_end", task="serve", requests=agg["ok"],
+                     rows=summary["rows"])
         return 0
 
     def _task_predict(self, trainer, itr) -> int:

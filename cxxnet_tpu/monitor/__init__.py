@@ -33,6 +33,7 @@ from __future__ import annotations
 import hashlib
 import json
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -100,22 +101,29 @@ class JsonlSink:
         self.flush_period = max(0.0, float(flush_period))
         self._f = open(path, "w")
         self._last_flush = time.monotonic()
+        # serve workers emit from several threads into one stream;
+        # unsynchronized writes would interleave bytes mid-line
+        self._wlock = threading.Lock()
 
     def write(self, record: Dict[str, Any]) -> None:
-        self._f.write(json.dumps(record, sort_keys=True) + "\n")
-        now = time.monotonic()
-        if now - self._last_flush >= self.flush_period:
-            self._f.flush()
-            self._last_flush = now
+        line = json.dumps(record, sort_keys=True) + "\n"
+        with self._wlock:
+            self._f.write(line)
+            now = time.monotonic()
+            if now - self._last_flush >= self.flush_period:
+                self._f.flush()
+                self._last_flush = now
 
     def flush(self) -> None:
-        self._f.flush()
-        self._last_flush = time.monotonic()
+        with self._wlock:
+            self._f.flush()
+            self._last_flush = time.monotonic()
 
     def close(self) -> None:
-        if not self._f.closed:
-            self._f.flush()
-            self._f.close()
+        with self._wlock:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
 
 
 class MemorySink:

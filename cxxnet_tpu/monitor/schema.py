@@ -54,15 +54,28 @@ REQUIRED: Dict[str, tuple] = {
     "test_io": ("instances", "wall_s", "instances_per_sec"),
     "task_end": ("task",),
     "run_end": ("wall_s", "steps", "examples"),
+    # serving telemetry (doc/serving.md): per-request outcome + waits,
+    # per-micro-batch fill/pad/device split, and the close-time rollup
+    "serve_request": ("status", "rows", "queue_ms", "latency_ms"),
+    "serve_batch": ("batch", "status", "rows", "requests", "bucket",
+                    "pad_rows", "fill_rate", "pad_fraction",
+                    "queue_ms", "device_ms"),
+    "serve_summary": ("requests", "rows", "batches", "rejected",
+                      "timeouts", "errors", "latency_p50_ms",
+                      "latency_p99_ms", "fill_rate", "pad_fraction",
+                      "wall_s"),
 }
 
 _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",
                 "mean_ms", "p50_ms", "p99_ms", "h2d_ms",
                 "consumer_wait_ms", "wall_s", "examples_per_sec",
-                "instances_per_sec")
+                "instances_per_sec", "queue_ms", "latency_ms",
+                "device_ms", "latency_p50_ms", "latency_p99_ms",
+                "rows_per_sec")
 
 # ratio fields must sit in [0, 1]
-_RATIO_KEYS = ("buffer_reuse_rate", "h2d_overlap_ratio")
+_RATIO_KEYS = ("buffer_reuse_rate", "h2d_overlap_ratio", "fill_rate",
+               "pad_fraction")
 
 
 def validate_record(rec: Dict[str, Any]) -> List[str]:

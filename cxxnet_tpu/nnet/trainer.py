@@ -593,9 +593,19 @@ class NetTrainer:
             return aot(*args)
         return jit_fn(*args, **static_kw)
 
+    @staticmethod
+    def pred_sig(shape, dtype, mask_is_none: bool, n_extra: int,
+                 nodes_wanted) -> tuple:
+        """The pred dispatch signature (sans the leading "pred" kind).
+        The single definition shared by `_call_pred`, `precompile_pred`
+        and the serve engine's compile-event accounting — a key-scheme
+        change here cannot strand one of them on a stale scheme."""
+        return (tuple(shape), str(dtype), mask_is_none, int(n_extra),
+                tuple(nodes_wanted))
+
     def _call_pred(self, data, mask, extra, nodes_wanted):
-        sig = (data.shape, str(data.dtype), mask is None, len(extra),
-               nodes_wanted)
+        sig = self.pred_sig(data.shape, data.dtype, mask is None,
+                            len(extra), nodes_wanted)
         return self._call_step(
             "pred", sig, self._pred_step,
             (self.params, self.net_state, data, mask, extra),
@@ -729,8 +739,8 @@ class NetTrainer:
                                      collect=c)))
             if self._metric_nodes:
                 nodes = tuple(self._metric_nodes)
-                key = ("pred", data_shape, dt_str, mask_v is None, 0,
-                       nodes)
+                key = ("pred",) + self.pred_sig(
+                    data_shape, dt_str, mask_v is None, 0, nodes)
                 programs.append((key, lambda m=mask_v, nw=nodes:
                                  self._pred_step.lower(
                                      self.params, self.net_state,
@@ -759,6 +769,21 @@ class NetTrainer:
                                  data_s, labels_s, m, (), hs, es,
                                  us, step_s, self._base_key)))
 
+        compiled = self._compile_programs(programs, "precompile_failed")
+        self.precompile_wall_s = time.perf_counter() - t_start
+        self.precompile_programs = compiled
+        if self._mon_on():
+            self._mon.emit("precompile",
+                           wall_ms=self.precompile_wall_s * 1e3,
+                           programs=compiled)
+        return compiled
+
+    def _compile_programs(self, programs, warn_code: str) -> int:
+        """AOT-compile ``(key, lower-thunk)`` pairs into ``_aot``,
+        skipping keys already compiled. The one compile loop behind
+        ``precompile`` and ``precompile_pred`` — failure fallback,
+        signature seeding and per-program telemetry must not drift
+        between the training and serving warmup paths."""
         compiled = 0
         for key, thunk in programs:
             if key in self._aot:
@@ -768,7 +793,7 @@ class NetTrainer:
                 self._aot[key] = thunk().compile()
             except Exception as e:
                 from ..monitor import warn_once
-                warn_once("precompile_failed",
+                warn_once(warn_code,
                           "precompile of %r failed (falling back to "
                           "jit): %s" % (key[0], e))
                 continue
@@ -781,11 +806,62 @@ class NetTrainer:
                 self._mon.emit("compile", kind="precompile",
                                wall_ms=(time.perf_counter() - t0) * 1e3,
                                signature=repr(key))
-        self.precompile_wall_s = time.perf_counter() - t_start
-        self.precompile_programs = compiled
+        return compiled
+
+    def precompile_pred(self, batch_sizes: Sequence[int],
+                        nodes_wanted: Optional[Sequence[int]] = None,
+                        dtype=None) -> int:
+        """AOT-compile the eval/pred forward at a set of batch-size
+        buckets — the serve-engine warmup path (doc/serving.md).
+
+        One executable per reachable (bucket, mask-variant): the
+        exactly-full variant (mask None — the mask-free specialization
+        every perfectly filled micro-batch dispatches) always, plus
+        the padded variant (rows rounded up to the bucket ride a zero
+        mask tail, the ``num_batch_padd`` machinery) for buckets a
+        partial batch can actually land in — the smallest row count
+        rounding up to bucket ``b`` is ``prev_bucket + 1``, so when
+        that equals ``b`` the masked program is dead and is skipped.
+        After this returns, a dispatch at any compiled bucket goes
+        straight to its executable — steady-state serving records zero
+        XLA compile events.
+
+        ``nodes_wanted`` are node indices (default: the top node, the
+        ``predict`` output); compile one call per distinct node set you
+        will serve. Failures fall back to the jit path with a one-time
+        warning — warmup must never take a server down. Returns the
+        number of programs compiled."""
+        assert self._initialized, "call init_model/load_model first"
+        from ..io.data import inst_array_shape
+        t_start = time.perf_counter()
+        self._enable_persistent_cache()
+        nodes = (self.graph.num_nodes - 1,) if nodes_wanted is None \
+            else tuple(nodes_wanted)
+        dt = np.dtype(np.float32 if dtype is None else dtype)
+        inst = inst_array_shape(tuple(self.graph.input_shape))
+        from ..serve.bucketing import reachable_variants
+        programs = []
+        data_structs = {}
+        for n, rows in reachable_variants(batch_sizes):
+            data_shape = (n,) + inst
+            if n not in data_structs:
+                data_structs[n] = jax.ShapeDtypeStruct(
+                    data_shape, dt,
+                    sharding=self._pin_layout(self._b_shard,
+                                              len(data_shape)))
+            mask_s = None if rows == n else jax.ShapeDtypeStruct(
+                (n,), np.float32, sharding=self._b_shard)
+            key = ("pred",) + self.pred_sig(
+                data_shape, dt, mask_s is None, 0, nodes)
+            programs.append((key, lambda ds=data_structs[n], m=mask_s:
+                             self._pred_step.lower(
+                                 self.params, self.net_state, ds,
+                                 m, (), nodes_wanted=nodes)))
+        compiled = self._compile_programs(programs,
+                                          "precompile_pred_failed")
         if self._mon_on():
             self._mon.emit("precompile",
-                           wall_ms=self.precompile_wall_s * 1e3,
+                           wall_ms=(time.perf_counter() - t_start) * 1e3,
                            programs=compiled)
         return compiled
 
@@ -1256,6 +1332,17 @@ class NetTrainer:
                            metrics={t: float(v) for t, v in res})
         return MetricSet.format_line(name, res)
 
+    @staticmethod
+    def rows_to_prediction(m: np.ndarray) -> np.ndarray:
+        """Output rows -> per-row prediction: the single raw column, or
+        the argmax class as float32 (nnet_impl-inl.hpp:317-330). The
+        one definition of the predict convention — the serve engine and
+        ``predict`` below must agree row for row."""
+        m = m.reshape(m.shape[0], -1)
+        if m.shape[1] == 1:
+            return m[:, 0]
+        return np.argmax(m, axis=1).astype(np.float32)
+
     def predict(self, batch: DataBatch) -> np.ndarray:
         """argmax class (or raw scalar) per row of the top node
         (nnet_impl-inl.hpp:317-330)."""
@@ -1264,10 +1351,7 @@ class NetTrainer:
                                  self._put_mask(batch),
                                  self._device_extra(batch), (top,))
         nvalid = self._local_batch_size(batch) - batch.num_batch_padd
-        m = self._local_rows(val)[:nvalid]
-        if m.shape[1] == 1:
-            return m[:, 0]
-        return np.argmax(m, axis=1).astype(np.float32)
+        return self.rows_to_prediction(self._local_rows(val)[:nvalid])
 
     def extract_feature(self, batch: DataBatch, node: str) -> np.ndarray:
         ni = self.net.node_index_by_name(node)

@@ -1,0 +1,29 @@
+"""Dynamic-batching inference: snapshot -> frozen engine -> dispatcher.
+
+The serving subsystem (doc/serving.md). Pieces:
+
+- :mod:`~cxxnet_tpu.serve.bucketing` — the batch-size bucket ladder
+  every padded dispatch shape comes from
+- :mod:`~cxxnet_tpu.serve.engine` — frozen eval-mode engine with AOT
+  executables per bucket (zero compile events after warmup)
+- :mod:`~cxxnet_tpu.serve.batcher` — coalescing micro-batch dispatcher:
+  bounded queue, reject-with-busy backpressure, per-request deadlines,
+  exception propagation, graceful drain, pipelined H2D hand-off
+- :mod:`~cxxnet_tpu.serve.server` — config-driven ``ServeSession`` and
+  the closed-loop client drive behind ``task = serve`` and
+  ``tools/serve_bench.py``
+"""
+
+from .batcher import (DynamicBatcher, ServeBusyError, ServeClosedError,
+                      ServeTimeoutError)
+from .bucketing import (bucket_ladder, mesh_align, pad_to_bucket,
+                        parse_buckets, pick_bucket)
+from .engine import InferenceEngine, StagedBatch, build_engine
+from .server import ServeConfig, ServeSession, run_closed_loop
+
+__all__ = [
+    "DynamicBatcher", "ServeBusyError", "ServeClosedError",
+    "ServeTimeoutError", "bucket_ladder", "mesh_align", "pad_to_bucket",
+    "parse_buckets", "pick_bucket", "InferenceEngine", "StagedBatch",
+    "build_engine", "ServeConfig", "ServeSession", "run_closed_loop",
+]

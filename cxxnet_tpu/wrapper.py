@@ -134,6 +134,7 @@ class Net:
         self._extra: List[Tuple[str, str]] = []
         self._trainer: Optional[NetTrainer] = None
         self._round = 0
+        self._pred_buckets = None        # pred-shape ladder, built lazily
 
     @staticmethod
     def _validate_netconfig(cfg) -> None:
@@ -207,6 +208,37 @@ class Net:
                 raise ValueError("Net.update: data size mismatch")
         return DataBatch(data=arr, label=label)
 
+    def _bucket_pred_batch(self, batch: DataBatch) -> DataBatch:
+        """Round a pred/extract batch up to its bucket so repeat calls
+        at varying sizes (e.g. a final partial batch) reuse one
+        compiled executable per bucket instead of compiling per size.
+
+        Pure shape policy via the serve bucketing helper: padded rows
+        ride the ``num_batch_padd`` mask and are sliced off the result,
+        so output is row-identical to the unpadded dispatch (pinned by
+        tests). Already-padded iterator batches pass through."""
+        from .serve.bucketing import (bucket_ladder, pad_to_bucket,
+                                      pick_bucket)
+        if batch.num_batch_padd:
+            return batch
+        t = self._req()
+        if self._pred_buckets is None:
+            align = dict(t.mesh.shape).get("data", 1)
+            self._pred_buckets = bucket_ladder(t.batch_size,
+                                               align=align)
+        n = batch.batch_size
+        bucket = pick_bucket(n, self._pred_buckets, extend=True)
+        if bucket == n:
+            return batch
+        data, npad = pad_to_bucket(np.asarray(batch.data), bucket)
+        label = batch.label
+        if label is not None:
+            label, _ = pad_to_bucket(np.asarray(label), bucket)
+        return DataBatch(
+            data=data, label=label, num_batch_padd=npad,
+            extra_data=[pad_to_bucket(np.asarray(e), bucket)[0]
+                        for e in batch.extra_data])
+
     # -- training / inference --------------------------------------------
 
     def update(self, data, label=None):
@@ -222,16 +254,20 @@ class Net:
         return self._req().evaluate(iter(data), name)
 
     def predict(self, data) -> np.ndarray:
-        """Predicted class index (or scalar output) per row."""
-        if isinstance(data, DataIter):
-            return self._req().predict(data.batch)
-        return self._req().predict(self._to_batch(data))
-
-    def extract(self, data, name: str) -> np.ndarray:
-        """Extract a named node's activations ('top[-k]' supported)."""
+        """Predicted class index (or scalar output) per row. Inputs
+        pad to a batch-size bucket (doc/serving.md) so varying caller
+        batch sizes reuse a handful of compiled executables."""
         batch = data.batch if isinstance(data, DataIter) \
             else self._to_batch(data)
-        out = self._req().extract_feature(batch, name)
+        return self._req().predict(self._bucket_pred_batch(batch))
+
+    def extract(self, data, name: str) -> np.ndarray:
+        """Extract a named node's activations ('top[-k]' supported).
+        Bucket-padded like :meth:`predict`."""
+        batch = data.batch if isinstance(data, DataIter) \
+            else self._to_batch(data)
+        out = self._req().extract_feature(self._bucket_pred_batch(batch),
+                                          name)
         return _internal_to_nchw(out)      # flat nodes -> (b,1,1,f)
 
     # -- weights ---------------------------------------------------------

@@ -184,3 +184,58 @@ model_in = %s
     probs = np.loadtxt(tp / "probs.txt")
     assert probs.shape == (100, 4)
     np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-3)
+
+
+def test_pred_fallback_warns_and_is_deterministic(setup, capsys):
+    """With no 'pred =' iterator block, pred-like tasks fall back to
+    the train data block — which is shuffled/augmented for training.
+    The fallback must warn once and neutralize the stochastic knobs so
+    two runs dump identical, file-order-aligned rows."""
+    from cxxnet_tpu.monitor.schema import read_jsonl
+    tmp_path, conf = setup
+    assert main([conf, "num_round=1"]) == 0
+    model = str(tmp_path / "models" / "0001.model.npz")
+
+    outs = []
+    for i in (1, 2):
+        pred_file = str(tmp_path / ("pred_%d.txt" % i))
+        mon_file = str(tmp_path / ("mon_%d.jsonl" % i))
+        assert main([conf, "task=pred", "model_in=" + model,
+                     "pred=" + pred_file, "monitor=jsonl",
+                     "monitor_path=" + mon_file]) == 0
+        outs.append(np.loadtxt(pred_file))
+        warns = [r for r in read_jsonl(mon_file)
+                 if r["event"] == "warning"
+                 and r["code"] == "pred_fallback_train_iter"]
+        assert len(warns) == 1, "fallback must warn exactly once"
+        assert "shuffle" in warns[0]["message"]
+    # shuffle off on the fallback path: runs agree row for row
+    assert np.array_equal(outs[0], outs[1])
+
+
+def test_serve_task_end_to_end(setup, capsys):
+    """task=serve: snapshot -> frozen bucketed engine -> dynamic
+    batcher -> threaded closed-loop soak, driven purely by config.
+    Steady state must record zero compile events, and the summary
+    telemetry must validate against the schema."""
+    from cxxnet_tpu.monitor.schema import read_jsonl, validate_records
+    tmp_path, conf = setup
+    assert main([conf, "num_round=1"]) == 0
+    model = str(tmp_path / "models" / "0001.model.npz")
+
+    mon_file = str(tmp_path / "serve.jsonl")
+    assert main([conf, "task=serve", "model_in=" + model,
+                 "serve_clients=4", "serve_requests=6",
+                 "serve_max_delay_ms=2", "monitor=jsonl",
+                 "monitor_path=" + mon_file]) == 0
+    out = capsys.readouterr().out
+    assert "serve:" in out and "compiles after warmup 0" in out
+    records = read_jsonl(mon_file)
+    assert validate_records(records) == []
+    summaries = [r for r in records if r["event"] == "serve_summary"]
+    assert len(summaries) == 1
+    s = summaries[0]
+    assert s["requests"] == 4 * 6 and s["errors"] == 0
+    assert s["compile_events"] == 0
+    assert s["latency_p99_ms"] >= s["latency_p50_ms"] > 0
+    assert [r for r in records if r["event"] == "serve_batch"]

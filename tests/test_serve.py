@@ -1,0 +1,450 @@
+"""Serve subsystem: bucketing policy, dynamic-batcher semantics
+(backpressure, deadlines, exception propagation, graceful drain),
+frozen-engine parity with the trainer pred path, the threaded CPU smoke
+(zero recompiles after warmup, clean shutdown), and the schema-drift
+guard over every emitted record kind."""
+
+import os
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.io.data import DataBatch
+from cxxnet_tpu.monitor import MemorySink, Monitor
+from cxxnet_tpu.monitor.schema import REQUIRED, validate_records
+from cxxnet_tpu.serve import (DynamicBatcher, InferenceEngine,
+                              ServeBusyError, ServeClosedError,
+                              ServeSession, ServeTimeoutError,
+                              bucket_ladder, mesh_align, pad_to_bucket,
+                              parse_buckets, pick_bucket,
+                              run_closed_loop)
+from tests.test_trainer import MLP_CONF, make_trainer
+
+
+# -- bucketing policy (pure, no jax) ------------------------------------
+
+
+def test_bucket_ladder_defaults_and_alignment():
+    assert bucket_ladder(50) == (1, 2, 4, 8, 16, 32, 50)
+    assert bucket_ladder(8) == (1, 2, 4, 8)
+    assert bucket_ladder(1) == (1,)
+    # align=4 drops the buckets a 4-way data axis cannot split
+    assert bucket_ladder(32, align=4) == (4, 8, 16, 32)
+    with pytest.raises(ValueError):
+        bucket_ladder(50, align=4)        # max_batch not a multiple
+
+
+def test_parse_buckets():
+    assert parse_buckets("auto", 32) == (1, 2, 4, 8, 16, 32)
+    assert parse_buckets("1,8", 32) == (1, 8, 32)   # max always rides
+    assert parse_buckets("8,1,8", 32) == (1, 8, 32)  # dedup + sort
+    with pytest.raises(ValueError):
+        parse_buckets("64", 32)           # above max_batch
+    with pytest.raises(ValueError):
+        parse_buckets("3,8", 32, align=4)  # misaligned bucket
+
+
+def test_pick_bucket_and_extend():
+    ladder = (1, 4, 8)
+    assert pick_bucket(1, ladder) == 1
+    assert pick_bucket(3, ladder) == 4
+    assert pick_bucket(8, ladder) == 8
+    assert pick_bucket(9, ladder) is None
+    # library path: oversized rounds to max * 2**k
+    assert pick_bucket(9, ladder, extend=True) == 16
+    assert pick_bucket(33, ladder, extend=True) == 64
+    with pytest.raises(ValueError):
+        pick_bucket(0, ladder)
+
+
+def test_mesh_align():
+    assert mesh_align((1, 2, 4, 8), max_devices=8) == 1
+    assert mesh_align((8, 16, 32), max_devices=8) == 8
+    assert mesh_align((8, 16, 32), max_devices=3) == 2
+    assert mesh_align((6, 9), max_devices=8) == 3
+
+
+def test_pad_to_bucket():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    same, npad = pad_to_bucket(x, 3)
+    assert same is x and npad == 0        # full bucket: no copy
+    padded, npad = pad_to_bucket(x, 5)
+    assert npad == 2 and padded.shape == (5, 4)
+    assert np.array_equal(padded[:3], x)
+    assert not padded[3:].any()
+    with pytest.raises(ValueError):
+        pad_to_bucket(x, 2)
+
+
+# -- dynamic batcher over a fake engine (no jax) ------------------------
+
+
+def _echo_batcher(monitor=None, **kw):
+    """Batcher whose 'engine' is the identity: stage passes rows
+    through, dispatch returns them — per-request row routing and every
+    concurrency semantic are exercised without a device."""
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_delay_ms", 2.0)
+    return DynamicBatcher(lambda rows: rows, lambda staged: staged,
+                          monitor=monitor, **kw)
+
+
+def test_batcher_routes_rows_to_requests():
+    sink = MemorySink()
+    b = _echo_batcher(monitor=Monitor(sink))
+    futs = [b.submit(np.full((n, 3), i, np.float32))
+            for i, n in enumerate((1, 2, 1, 3, 4))]
+    for i, (f, n) in enumerate(zip(futs, (1, 2, 1, 3, 4))):
+        out = f.result(timeout=5)
+        assert out.shape == (n, 3)
+        assert (out == i).all()
+    summary = b.close()
+    assert summary["requests"] == 5 and summary["rows"] == 11
+    assert summary["errors"] == 0 and summary["rejected"] == 0
+    assert validate_records(sink.records) == []
+    kinds = {r["event"] for r in sink.records}
+    assert {"serve_request", "serve_batch", "serve_summary"} <= kinds
+
+
+def test_batcher_rejects_oversized_and_empty_requests():
+    b = _echo_batcher()
+    with pytest.raises(ValueError):
+        b.submit(np.zeros((5, 2), np.float32))   # > max_batch
+    with pytest.raises(ValueError):
+        b.submit(np.zeros((0, 2), np.float32))
+    b.close()
+
+
+def test_batcher_bounces_mismatched_row_shape_to_its_sender():
+    """A request whose per-row shape disagrees with the served shape
+    must fail at submit — coalescing it would blow up the shared
+    np.concatenate and take down every client's batch."""
+    b = _echo_batcher(row_shape=(3,))
+    with pytest.raises(ValueError, match="row shape"):
+        b.submit(np.zeros((1, 5), np.float32))
+    ok = b.submit(np.ones((1, 3), np.float32))
+    assert ok.result(timeout=5).shape == (1, 3)
+    b.close()
+    # without an explicit row_shape the first request's shape is law
+    b2 = _echo_batcher()
+    f = b2.submit(np.ones((1, 3), np.float32))
+    with pytest.raises(ValueError, match="row shape"):
+        b2.submit(np.zeros((1, 5), np.float32))
+    assert f.result(timeout=5).shape == (1, 3)
+    b2.close()
+
+
+def test_batcher_survives_client_cancelled_future():
+    """fut.cancel() before batch form must not kill a worker thread:
+    the cancelled request is skipped at the commit point and every
+    other client still gets its result."""
+    b = _echo_batcher(max_batch=4, max_delay_ms=30.0)
+    doomed = b.submit(np.zeros((1, 2), np.float32))
+    assert doomed.cancel()
+    live = b.submit(np.ones((1, 2), np.float32))
+    assert (live.result(timeout=5) == 1).all()
+    summary = b.close()
+    assert b.counters["cancelled"] == 1
+    assert summary["requests"] == 1      # only the live request counted
+    assert not b._collector.is_alive()
+    assert not b._dispatcher.is_alive()
+
+
+def test_batcher_backpressure_rejects_when_queue_full():
+    gate = threading.Event()
+    sink = MemorySink()
+
+    def blocked_dispatch(rows):
+        gate.wait(10)
+        return rows
+
+    b = DynamicBatcher(lambda r: r, blocked_dispatch, max_batch=1,
+                       max_delay_ms=0.0, max_queue_rows=2,
+                       stage_depth=1, monitor=Monitor(sink))
+    futs, saw_busy = [], False
+    for _ in range(30):
+        try:
+            futs.append(b.submit(np.ones((1, 2), np.float32)))
+        except ServeBusyError:
+            saw_busy = True
+            break
+        time.sleep(0.01)
+    assert saw_busy, "bounded queue never pushed back"
+    assert b.counters["rejected"] >= 1
+    gate.set()
+    summary = b.close(drain=True)
+    for f in futs:                         # accepted work still completes
+        assert f.result(timeout=5).shape == (1, 2)
+    assert summary["rejected"] >= 1
+    busy = [r for r in sink.records if r["event"] == "serve_request"
+            and r["status"] == "busy"]
+    assert busy and validate_records(sink.records) == []
+
+
+def test_batcher_request_deadline_times_out_in_queue():
+    sink = MemorySink()
+    # 1 pending row < max_batch keeps the batch open for the full
+    # 80 ms delay window; the 1 ms deadline expires inside it
+    b = _echo_batcher(monitor=Monitor(sink), max_batch=4,
+                      max_delay_ms=80.0)
+    f = b.submit(np.zeros((1, 2), np.float32), timeout_ms=1.0)
+    with pytest.raises(ServeTimeoutError):
+        f.result(timeout=5)
+    b.close()
+    assert b.counters["timeouts"] == 1
+    tos = [r for r in sink.records if r["event"] == "serve_request"
+           and r["status"] == "timeout"]
+    assert len(tos) == 1
+
+
+def test_batcher_propagates_engine_errors_and_keeps_serving():
+    def dispatch(rows):
+        if np.isnan(rows).any():
+            raise ValueError("poisoned batch")
+        return rows
+
+    b = DynamicBatcher(lambda r: r, dispatch, max_batch=4,
+                       max_delay_ms=1.0)
+    bad = b.submit(np.full((2, 2), np.nan, np.float32))
+    with pytest.raises(ValueError, match="poisoned"):
+        bad.result(timeout=5)
+    good = b.submit(np.ones((2, 2), np.float32))   # loop survives
+    assert (good.result(timeout=5) == 1).all()
+    summary = b.close()
+    assert summary["errors"] == 1 and summary["requests"] == 1
+
+
+def test_batcher_graceful_drain_completes_queued_work():
+    done = []
+
+    def slow_dispatch(rows):
+        time.sleep(0.005)
+        done.append(rows.shape[0])
+        return rows
+
+    b = DynamicBatcher(lambda r: r, slow_dispatch, max_batch=4,
+                       max_delay_ms=1.0, max_queue_rows=100)
+    futs = [b.submit(np.full((1, 2), i, np.float32))
+            for i in range(20)]
+    summary = b.close(drain=True)          # drains everything queued
+    for i, f in enumerate(futs):
+        assert (f.result(timeout=5) == i).all()
+    assert summary["requests"] == 20 and sum(done) == 20
+    assert not b._collector.is_alive()
+    assert not b._dispatcher.is_alive()
+    with pytest.raises(ServeClosedError):
+        b.submit(np.zeros((1, 2), np.float32))
+
+
+def test_batcher_close_without_drain_fails_pending():
+    gate = threading.Event()
+    b = DynamicBatcher(lambda r: r, lambda r: (gate.wait(10), r)[1],
+                       max_batch=1, max_delay_ms=0.0,
+                       max_queue_rows=100, stage_depth=1)
+    futs = [b.submit(np.full((1, 2), i, np.float32))
+            for i in range(6)]
+    # wait until the pipeline is saturated (1 dispatching + 1 staged +
+    # 1 in the collector's hand) and the rest sit in the pending queue
+    for _ in range(500):
+        if b._pending_rows == 3:
+            break
+        time.sleep(0.01)
+    assert b._pending_rows == 3
+    closer = threading.Thread(target=b.close, kwargs={"drain": False})
+    closer.start()
+    for _ in range(500):                    # close fails pending first
+        if any(f.done() and f.exception() for f in futs):
+            break
+        time.sleep(0.01)
+    gate.set()
+    closer.join(timeout=10)
+    assert not closer.is_alive()
+    states = [("closed" if isinstance(f.exception(), ServeClosedError)
+               else "ok") for f in futs]
+    assert states.count("closed") >= 3      # the pending tail failed
+    assert states[0] == "ok"                # in-flight work completed
+
+
+# -- frozen engine: tail-batch parity with the trainer path -------------
+
+
+@pytest.fixture(scope="module")
+def mlp():
+    """One initialized single-device MLP shared by the engine tests
+    (random weights: pred-path parity does not need convergence)."""
+    from cxxnet_tpu.parallel import make_mesh
+    return make_trainer(MLP_CONF, mesh=make_mesh(1, 1))
+
+
+def _rows(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.uniform(0, 1, size=(n, 256)).astype(np.float32)
+
+
+def test_trainer_pred_tail_batch_matches_unpadded(mlp):
+    """num_batch_padd rows must not perturb the valid rows: the same 30
+    examples produce the same predictions dispatched at their natural
+    shape and padded into the full batch."""
+    X = _rows(30)
+    plain = DataBatch(data=X, label=np.zeros((30, 1), np.float32))
+    padded_X, npad = pad_to_bucket(X, 50)
+    assert npad == 20
+    padded = DataBatch(data=padded_X,
+                       label=np.zeros((50, 1), np.float32),
+                       num_batch_padd=npad)
+    p1, p2 = mlp.predict(plain), mlp.predict(padded)
+    assert p1.shape == p2.shape == (30,)
+    assert np.array_equal(p1, p2)
+    f1 = mlp.extract_feature(plain, "h")
+    f2 = mlp.extract_feature(padded, "h")
+    assert f1.shape == f2.shape == (30, 32)
+    np.testing.assert_allclose(f1, f2, rtol=1e-5, atol=1e-6)
+
+
+def test_engine_matches_trainer_pred(mlp):
+    eng = InferenceEngine(mlp)
+    assert eng.buckets == (1, 2, 4, 8, 16, 32, 50)
+    X = _rows(30)
+    want = mlp.predict(
+        DataBatch(data=X, label=np.zeros((30, 1), np.float32)))
+    # 30 rows pad to the 32 bucket inside the engine
+    got = eng.predict(X)
+    np.testing.assert_allclose(got, want)
+    # raw node rows through run(), row-for-row, any chunking
+    top = mlp.extract_feature(
+        DataBatch(data=X, label=np.zeros((30, 1), np.float32)), "o")
+    np.testing.assert_allclose(eng.run(X), top, rtol=1e-5, atol=1e-6)
+    # oversized input chunks at max_batch and concatenates back
+    X2 = _rows(73, seed=3)
+    assert eng.predict(X2).shape == (73,)
+    np.testing.assert_allclose(eng.predict(X2)[:30],
+                               eng.predict(X2[:30]), rtol=1e-5,
+                               atol=1e-6)
+    with pytest.raises(ValueError):
+        eng.stage(_rows(51))               # beyond the largest bucket
+
+
+def test_engine_warmup_kills_steady_state_compiles(mlp):
+    eng = InferenceEngine(mlp, buckets=(1, 4, 8))
+    compiled = eng.warmup()
+    # (bucket, mask-variant) programs: bucket 1 has no padded variant
+    assert compiled >= len(eng.buckets)
+    for n in (1, 2, 3, 4, 5, 8):           # every fill level
+        eng.predict(_rows(n, seed=n))
+    # any input dtype casts to the compiled float32 — a uint8 client
+    # must not trigger a steady-state compile
+    eng.predict((_rows(3, seed=9) * 255).astype(np.uint8))
+    c = eng.counters_snapshot()
+    assert c["compile_events"] == 0, c
+    assert c["aot_hits"] == c["dispatches"] > 0
+    assert c["pad_rows"] == (0 + 2 + 1 + 0 + 3 + 0 + 1)
+
+
+# -- the serve smoke: threaded clients, zero recompiles, clean stop ------
+
+
+def test_serve_session_smoke_threaded_clients(mlp):
+    """The tier-1 serve smoke (ISSUE 4 acceptance): 8 threaded
+    closed-loop clients through the full engine+batcher path on CPU,
+    zero XLA compile events after warmup, schema-valid telemetry,
+    clean shutdown."""
+    sink = MemorySink()
+    mon = Monitor(sink)
+    eng = InferenceEngine(mlp, buckets=(1, 4, 8, 16, 50), monitor=mon)
+    session = ServeSession(
+        [("serve_max_batch", "50"), ("serve_max_delay_ms", "2")],
+        engine=eng, monitor=mon)
+    pool = _rows(64)
+    agg = run_closed_loop(session, pool, clients=8, requests=12,
+                          request_rows=1)
+    summary = session.close()
+    assert agg["ok"] == 8 * 12
+    assert agg["busy"] == agg["timeout"] == agg["error"] == 0
+    assert summary["requests"] == 96 and summary["rows"] == 96
+    assert summary["errors"] == 0
+    assert summary["compile_events"] == 0, \
+        "steady-state serving recompiled"
+    assert summary["latency_p99_ms"] >= summary["latency_p50_ms"] > 0
+    assert 0 < summary["fill_rate"] <= 1
+    assert not session.batcher._collector.is_alive()
+    assert not session.batcher._dispatcher.is_alive()
+    assert validate_records(sink.records) == []
+    events = {r["event"] for r in sink.records}
+    assert {"serve_request", "serve_batch", "serve_summary"} <= events
+    # correctness under concurrency: a served row equals the direct path
+    np.testing.assert_allclose(
+        eng.predict(pool[:5]),
+        mlp.predict(DataBatch(data=pool[:5],
+                              label=np.zeros((5, 1), np.float32))))
+
+
+# -- wrapper: pred-executable reuse across caller batch sizes -----------
+
+
+def test_wrapper_predict_buckets_varying_batch_sizes():
+    from cxxnet_tpu.wrapper import Net
+    from tests.test_wrapper import NET_CFG
+    rng = np.random.RandomState(0)
+    X = rng.rand(16, 1, 1, 10).astype(np.float32)    # NCHW API edge
+    net = Net(cfg=NET_CFG)                            # batch_size = 8
+    net.init_model()
+    t = net._trainer
+    shapes = []
+    orig = t._call_pred
+
+    def spy(data, mask, extra, nodes):
+        shapes.append(tuple(data.shape))
+        return orig(data, mask, extra, nodes)
+
+    t._call_pred = spy
+    full = net.predict(X[:8])
+    # every partial size dispatches at a bucket shape, rows unchanged
+    for n in (3, 5, 6, 7):
+        np.testing.assert_allclose(net.predict(X[:n]), full[:n])
+    # the ladder is mesh-aligned (under the 8-device conftest the data
+    # axis forces buckets of 8); the invariant is that 5 caller sizes
+    # collapse onto the handful of bucket shapes, not one shape each
+    buckets = net._pred_buckets
+    assert buckets[-1] == 8
+    assert {s[0] for s in shapes} <= set(buckets)
+    assert len(set(shapes)) <= 2 < 5, shapes
+    assert shapes.count((8, 10)) >= 3      # 5, 6, 7 share the 8 bucket
+    # oversized requests extend the ladder instead of compiling at 11
+    shapes.clear()
+    p11 = net.predict(X[:11])
+    assert shapes == [(16, 10)]
+    np.testing.assert_allclose(p11[:8], full)
+    feats = net.extract(X[:5], "top[-1]")            # extract buckets too
+    assert feats.shape[0] == 5
+
+
+# -- schema drift guard --------------------------------------------------
+
+
+def test_every_emitted_record_kind_has_a_validator():
+    """Grep-driven: every literal event name passed to Monitor.emit
+    anywhere in the tree must have a REQUIRED entry in monitor/schema.py
+    — a new record kind cannot ship unvalidated."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pat = re.compile(r"\bemit\(\s*[\"']([a-z_]+)[\"']")
+    emitted = {}
+    for base in ("cxxnet_tpu", "tools"):
+        for dirpath, _, files in os.walk(os.path.join(root, base)):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                with open(path) as f:
+                    src = f.read()
+                for m in pat.finditer(src):
+                    emitted.setdefault(m.group(1), path)
+    assert emitted, "grep found no emit sites — pattern rotted"
+    missing = {k: v for k, v in emitted.items() if k not in REQUIRED}
+    assert not missing, \
+        "record kinds emitted without a schema validator: %r" % missing
+    # and the serve records specifically are part of the contract
+    for kind in ("serve_request", "serve_batch", "serve_summary"):
+        assert kind in REQUIRED

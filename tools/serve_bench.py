@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Closed-loop serve benchmark: client sweep over the serve subsystem.
+
+Drives N threaded closed-loop clients (each waits for its result before
+sending the next request) through a ``ServeSession`` and reports one
+BENCH-style JSON record on stdout: per-sweep-point request throughput,
+latency p50/p99, micro-batch fill rate and pad fraction — all read back
+from the schema-validated ``serve_*`` telemetry records rather than
+re-derived timers (the bench.py rule), plus a ``zero_recompiles``
+verdict (no XLA compile events after warmup at any sweep point).
+
+Default is a self-contained synthetic MLP on whatever platform jax
+picks (set ``JAX_PLATFORMS=cpu`` for the CPU smoke run); pass
+``--conf``/``--model-in`` to sweep a real snapshot instead.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/serve_bench.py --clients 1,2,4,8
+    python tools/serve_bench.py --conf run.conf --model-in 0010.model.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SYNTH_CONF = """
+netconfig=start
+layer[+1:h] = fullc:fc1
+  nhidden = 64
+  init_sigma = 0.05
+layer[+1] = relu
+layer[h->o] = fullc:fc2
+  nhidden = 10
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,256
+batch_size = 32
+eta = 0.1
+"""
+
+
+def build_session(args, monitor):
+    from cxxnet_tpu.serve import InferenceEngine, ServeSession
+    from cxxnet_tpu.utils.config import parse_config, parse_config_file
+    serve_pairs = [
+        ("serve_buckets", args.buckets),
+        ("serve_max_delay_ms", str(args.max_delay_ms)),
+        ("serve_queue_rows", str(args.queue_rows)),
+    ]
+    if args.conf:
+        cfg = parse_config_file(args.conf) + serve_pairs
+        assert args.model_in, "--conf needs --model-in"
+        return ServeSession(cfg, model_path=args.model_in,
+                            monitor=monitor)
+    # synthetic: random weights are fine — serving cost does not depend
+    # on what the weights converged to
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.parallel import make_mesh
+    cfg = parse_config(SYNTH_CONF) + serve_pairs
+    trainer = NetTrainer(cfg, mesh=make_mesh(1, 1))
+    trainer.init_model()
+    trainer.set_monitor(monitor)
+    from cxxnet_tpu.serve.bucketing import parse_buckets
+    engine = InferenceEngine(
+        trainer, buckets=parse_buckets(args.buckets, 32),
+        monitor=monitor)
+    return ServeSession(cfg, engine=engine, monitor=monitor)
+
+
+def sweep_point(args, clients, monitor, sink):
+    """One sweep point = one fresh session (clean counters and
+    telemetry), ``clients`` closed-loop clients, stats read back from
+    the emitted records."""
+    from cxxnet_tpu.monitor.schema import validate_records
+    from cxxnet_tpu.serve import run_closed_loop
+    sink.clear()
+    session = build_session(args, monitor)
+    rng = np.random.RandomState(0)
+    inst = session.engine._inst_shape()
+    pool = rng.uniform(0, 1, size=(256,) + inst).astype(np.float32)
+    agg = run_closed_loop(session, pool, clients, args.requests,
+                          args.request_rows)
+    summary = session.close()
+    errs = validate_records(sink.records)
+    assert not errs, "schema-invalid serve telemetry: %s" % errs[:5]
+    batches = [r for r in sink.records if r["event"] == "serve_batch"]
+    return {
+        "clients": clients,
+        "requests_ok": agg["ok"],
+        "requests_busy": agg["busy"],
+        "requests_error": agg["error"] + agg["timeout"],
+        "rows_per_sec": round(agg["rows_per_sec"], 2),
+        "latency_p50_ms": summary["latency_p50_ms"],
+        "latency_p99_ms": summary["latency_p99_ms"],
+        "fill_rate": round(summary["fill_rate"], 4),
+        "pad_fraction": round(summary["pad_fraction"], 4),
+        "batches": summary["batches"],
+        "mean_rows_per_batch": round(
+            summary["rows"] / max(1, summary["batches"]), 2),
+        "compile_events": summary["compile_events"],
+        "serve_batch_records": len(batches),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", default="1,2,4,8",
+                    help="comma list of concurrent client counts")
+    ap.add_argument("--requests", type=int, default=50,
+                    help="closed-loop requests per client")
+    ap.add_argument("--request-rows", type=int, default=1)
+    ap.add_argument("--buckets", default="auto")
+    ap.add_argument("--max-delay-ms", type=float, default=2.0)
+    ap.add_argument("--queue-rows", type=int, default=0)
+    ap.add_argument("--conf", default="",
+                    help="config file (with --model-in) instead of the "
+                         "synthetic MLP")
+    ap.add_argument("--model-in", default="")
+    ap.add_argument("--out", default="",
+                    help="also write the JSON record to this path")
+    args = ap.parse_args(argv)
+
+    from cxxnet_tpu.monitor import MemorySink, Monitor
+    import jax
+    sink = MemorySink()
+    monitor = Monitor(sink)
+    points = []
+    for clients in [int(t) for t in args.clients.split(",") if t]:
+        t0 = time.time()
+        pt = sweep_point(args, clients, monitor, sink)
+        pt["wall_s"] = round(time.time() - t0, 2)
+        points.append(pt)
+        print("# clients=%d: %.1f rows/s, p50 %.2f ms, p99 %.2f ms, "
+              "fill %.2f, compiles %d"
+              % (clients, pt["rows_per_sec"], pt["latency_p50_ms"],
+                 pt["latency_p99_ms"], pt["fill_rate"],
+                 pt["compile_events"]), file=sys.stderr)
+    rec = {
+        "name": "serve_bench",
+        "t": time.time(),
+        "platform": jax.default_backend(),
+        "model": args.conf or "synthetic_mlp_256_64_10",
+        "buckets": args.buckets,
+        "max_delay_ms": args.max_delay_ms,
+        "requests_per_client": args.requests,
+        "request_rows": args.request_rows,
+        "sweep": points,
+        "zero_recompiles": all(p["compile_events"] == 0
+                               for p in points),
+    }
+    out = json.dumps(rec, sort_keys=True)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    return 0 if rec["zero_recompiles"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
