@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import os
 import re
+import signal
 import sys
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -25,14 +27,20 @@ from .io import create_iterator
 from .io.iter_batch import enable_chain_wait_stats, pipeline_snapshot
 from .monitor import (Monitor, create_monitor, device_memory_snapshot,
                       run_metadata, set_global)
+from .nnet.checkpoint import CheckpointManager, find_latest_valid
 from .nnet.trainer import NetTrainer
-from .parallel import (init_distributed, is_root, synced_batches,
-                       world_size)
+from .parallel import (allreduce_host_sum, init_distributed, is_root,
+                       synced_batches, world_size)
 from .utils.config import (parse_cli_overrides, parse_config_file,
                            split_sections)
-from .utils.stream import list_stream_dir, open_stream, uri_scheme
+from .utils.stream import open_stream, set_stream_retry, uri_scheme
 
 _MODEL_RE = re.compile(r"^(\d{4})\.model\.npz$")
+
+# exit code of a preempted run: SIGTERM/SIGINT arrived, the emergency
+# snapshot committed, telemetry flushed. EX_TEMPFAIL — schedulers and
+# wrapper scripts treat it as "re-queue me" (doc/checkpointing.md)
+EXIT_PREEMPTED = 75
 
 # tasks that read data through the pred iterator (or its fallback)
 _PRED_TASKS = ("pred", "extract_feature", "extract", "pred_raw", "serve")
@@ -85,11 +93,23 @@ class LearnTask:
         # combined with compile_cache_dir the compiles amortize across
         # runs (doc/observability.md)
         self.precompile = 0
+        # crash-safe checkpointing (doc/checkpointing.md): background
+        # commit thread, retention GC, durable fsync, remote-read
+        # retries. checkpoint_async=1 keeps the training thread's
+        # share of a snapshot to the device->host gather.
+        self.checkpoint_async = 1
+        self.checkpoint_fsync = 1
+        self.keep_snapshots = 0          # 0 = keep every snapshot
+        self.stream_retry = 0            # remote read retries (opt-in)
         # observability (doc/observability.md); a null monitor until
         # run() builds the configured one, so task methods are safe to
         # call directly in tests
         self._mon = Monitor()
         self._cfg_stream = []
+        self._resume_report = None
+        # preemption flag set from the SIGTERM/SIGINT handler; holds
+        # the signal number until the train loop's next update boundary
+        self._preempt_signum: Optional[int] = None
 
     # -- config ----------------------------------------------------------
 
@@ -143,6 +163,14 @@ class LearnTask:
             self.dispatch_period = max(1, int(val))
         if name == "precompile":
             self.precompile = int(val)
+        if name == "checkpoint_async":
+            self.checkpoint_async = int(val)
+        if name == "checkpoint_fsync":
+            self.checkpoint_fsync = int(val)
+        if name == "keep_snapshots":
+            self.keep_snapshots = int(val)
+        if name == "stream_retry":
+            self.stream_retry = int(val)
 
     # -- model files -----------------------------------------------------
 
@@ -153,19 +181,25 @@ class LearnTask:
         return os.path.join(self.model_dir, "%04d.model.npz" % counter)
 
     def _sync_latest_model(self) -> Optional[str]:
-        """Find the newest snapshot in model_dir (cxxnet_main:180-202);
-        works for remote model_dir URIs via the stream layer."""
-        best = None
-        for fn in list_stream_dir(self.model_dir):
-            m = _MODEL_RE.match(fn)
-            if m:
-                c = int(m.group(1))
-                if best is None or c > best:
-                    best = c
-        if best is None:
+        """Find the newest *valid* snapshot in model_dir
+        (cxxnet_main:180-202, hardened): every candidate is
+        digest/structure verified newest-first, corrupt ones are
+        quarantined with a warning, and only a snapshot that actually
+        loads is handed to load_model. Works for remote model_dir URIs
+        via the stream layer."""
+        rep = find_latest_valid(self.model_dir, monitor=self._mon)
+        self._resume_report = rep
+        if rep.path is None:
+            if rep.quarantined:
+                self._mon.warn_once(
+                    "resume_no_valid_snapshot",
+                    "continue=1: model_dir %r holds %d snapshot(s) but "
+                    "none verifies — quarantined %s and starting from "
+                    "round 0" % (self.model_dir, rep.scanned,
+                                 ", ".join(rep.quarantined)))
             return None
-        self.start_counter = best + 1
-        return self._model_path(best)
+        self.start_counter = rep.counter + 1
+        return rep.path
 
     # -- run -------------------------------------------------------------
 
@@ -201,6 +235,9 @@ class LearnTask:
         self._cfg_stream = cfg
         self._mon = create_monitor(global_cfg)
         set_global(self._mon)
+        # opt-in retry for transient remote-stream reads (flaky object
+        # stores on preemptible capacity); 0 = fail fast, the default
+        set_stream_retry(self.stream_retry)
 
         # iterators (closed on exit: prefetch threads / decode pools);
         # hoisted above the try so the finally can always iterate it
@@ -218,6 +255,15 @@ class LearnTask:
                 latest = self._sync_latest_model()
                 if latest is not None:
                     self.model_in = latest
+                rep = self._resume_report
+                if self._mon.enabled and rep is not None:
+                    self._mon.emit(
+                        "resume",
+                        source=latest or "",
+                        counter=-1 if rep.counter is None
+                        else rep.counter,
+                        scanned=rep.scanned,
+                        quarantined=len(rep.quarantined))
 
             itr_train = None
             eval_iters: List[Tuple[str, object]] = []
@@ -348,6 +394,66 @@ class LearnTask:
                      instances_per_sec=ips)
         return 0
 
+    # -- preemption ------------------------------------------------------
+
+    def _install_preempt_handlers(self):
+        """Catch SIGTERM/SIGINT (the preemption notice) and convert
+        them into a flag the train loop honors at the next update
+        boundary — an emergency snapshot beats dying mid-write. Only
+        the main thread can own signal handlers; library callers on
+        other threads keep their process defaults."""
+        if threading.current_thread() is not threading.main_thread():
+            return []
+        installed = []
+
+        def _on_signal(signum, frame):
+            self._preempt_signum = signum
+
+        for s in (signal.SIGTERM, signal.SIGINT):
+            try:
+                installed.append((s, signal.signal(s, _on_signal)))
+            except (ValueError, OSError):
+                pass
+        return installed
+
+    @staticmethod
+    def _restore_handlers(installed) -> None:
+        for s, old in installed:
+            try:
+                signal.signal(s, old)
+            except (ValueError, OSError, TypeError):
+                pass
+
+    def _preempt_now(self) -> bool:
+        """True when any rank has a pending preemption signal. Multi-
+        process: a host allreduce so every rank takes the emergency
+        exit at the same update boundary (a lone rank breaking out of
+        the SPMD loop would deadlock the others) — call at identical
+        points on all ranks."""
+        flagged = self._preempt_signum is not None
+        if world_size() > 1:
+            total = allreduce_host_sum(
+                np.asarray([1 if flagged else 0], np.int32))
+            return int(np.asarray(total)[0]) > 0
+        return flagged
+
+    def _preempt_exit(self, ckpt, round_idx: int, mon) -> int:
+        """Emergency snapshot at the current update boundary, clean
+        telemetry, distinct exit code. ``round_idx`` rounds completed
+        fully, so the snapshot commits under counter ``round_idx`` —
+        resume re-runs the interrupted round from its start with the
+        mid-round weights (never loses a completed round)."""
+        signum = int(self._preempt_signum or 0)
+        if self.silent == 0 and is_root():
+            mon.line("preempted by signal %d: emergency snapshot "
+                     "%04d.model.npz" % (signum, round_idx))
+        ckpt.save(round_idx, emergency=True)
+        ckpt.close()
+        if mon.enabled:
+            mon.emit("preempt", signal=signum, round=round_idx,
+                     exit_code=EXIT_PREEMPTED)
+        return EXIT_PREEMPTED
+
     def _task_train(self, trainer, itr_train, eval_iters) -> int:
         assert itr_train is not None, "train requires a data block"
         mon = self._mon
@@ -367,6 +473,14 @@ class LearnTask:
             # path never pays the per-batch clock reads
             io_hist = enable_chain_wait_stats(itr_train)
         k = self.dispatch_period
+        # checkpoints go through the manager: atomic commit + digest,
+        # background writer (checkpoint_async), retention GC
+        # (keep_snapshots), telemetry (doc/checkpointing.md)
+        ckpt = CheckpointManager(
+            trainer, self._model_path, model_dir=self.model_dir,
+            monitor=mon, async_=bool(self.checkpoint_async),
+            fsync=bool(self.checkpoint_fsync),
+            keep=self.keep_snapshots)
         if self.precompile:
             # AOT-compile every dispatch signature of the steady-state
             # loop (per-batch tail, K-batch window, eval forward) before
@@ -382,79 +496,114 @@ class LearnTask:
                 mon.line("round %8d:[%8d] %ld sec elapsed"
                          % (r, nbatch, int(time.time() - start)))
 
-        for r in range(self.start_counter - 1, self.num_round):
-            trainer.start_round(r)
-            if monitored:
-                mon.emit("round_start", round=r)
-            # trace hooks are NOT gated on an enabled sink: a profiler
-            # trace is one config line (monitor_trace_dir) away even
-            # with monitor = none, as doc/debug_perf.md advertises
-            mon.maybe_start_trace(r)
-            nbatch = 0
-            window = []
-            t_wait = time.perf_counter() if monitored else 0.0
-            # lockstep across ranks: unequal per-rank batch counts would
-            # deadlock the SPMD collectives (see parallel.synced_batches)
-            for batch in synced_batches(itr_train, window=k):
+        # installed inside the try so every exit path restores the
+        # process handlers (a long-lived library caller must get its
+        # Ctrl-C back even when the loop below raises)
+        handlers = []
+        ndisp = 0
+        try:
+            handlers = self._install_preempt_handlers()
+            for r in range(self.start_counter - 1, self.num_round):
+                # update-boundary preemption check (collective when
+                # multi-process): r rounds have fully completed
+                if self._preempt_now():
+                    return self._preempt_exit(ckpt, r, mon)
+                trainer.start_round(r)
                 if monitored:
-                    # data-wait half of the step-time split: time this
-                    # loop spent blocked on the iterator since the last
-                    # dispatch
-                    trainer.note_data_wait(time.perf_counter() - t_wait)
-                if k == 1:
-                    trainer.update(batch)
+                    mon.emit("round_start", round=r)
+                # trace hooks are NOT gated on an enabled sink: a
+                # profiler trace is one config line (monitor_trace_dir)
+                # away even with monitor = none (doc/debug_perf.md)
+                mon.maybe_start_trace(r)
+                nbatch = 0
+                window = []
+                t_wait = time.perf_counter() if monitored else 0.0
+                # lockstep across ranks: unequal per-rank batch counts
+                # would deadlock the SPMD collectives (see
+                # parallel.synced_batches)
+                for batch in synced_batches(itr_train, window=k):
+                    if monitored:
+                        # data-wait half of the step-time split: time
+                        # this loop spent blocked on the iterator since
+                        # the last dispatch
+                        trainer.note_data_wait(
+                            time.perf_counter() - t_wait)
+                    if k == 1:
+                        trainer.update(batch)
+                        nbatch += 1
+                    else:
+                        window.append(batch)
+                        if len(window) < k:
+                            if monitored:
+                                t_wait = time.perf_counter()
+                            continue
+                        trainer.update_many(window)
+                        nbatch += len(window)
+                        window = []
+                    _progress(r, nbatch)
+                    # every rank reaches each dispatch boundary the
+                    # same number of times (synced_batches), so the
+                    # collective preemption check stays in lockstep.
+                    # Multi-process, the check is a blocking host
+                    # allgather — throttle it to every 8th dispatch
+                    # (the shared ndisp counter keeps ranks agreeing
+                    # on WHICH dispatches check) so the hot path does
+                    # not grow a second per-dispatch host collective
+                    ndisp += 1
+                    if (world_size() == 1 or ndisp % 8 == 0) \
+                            and self._preempt_now():
+                        return self._preempt_exit(ckpt, r, mon)
+                    if monitored:
+                        t_wait = time.perf_counter()
+                for batch in window:    # round tail: per-batch (a short
+                    trainer.update(batch)  # window would recompile)
                     nbatch += 1
-                else:
-                    window.append(batch)
-                    if len(window) < k:
-                        if monitored:
-                            t_wait = time.perf_counter()
-                        continue
-                    trainer.update_many(window)
-                    nbatch += len(window)
-                    window = []
-                _progress(r, nbatch)
+                trainer.end_round()     # close the throughput window
+                #                         before evals start
+                line = "[%d]" % (r + 1)
+                if self.task_eval_train:
+                    line += trainer.train_metric_str("train")
+                for name, it in eval_iters:
+                    line += trainer.evaluate(it, name)
+                if self.silent == 0 and is_root():
+                    mon.line(line)
+                mon.maybe_stop_trace(r)
                 if monitored:
-                    t_wait = time.perf_counter()
-            for batch in window:        # round tail: per-batch (a short
-                trainer.update(batch)   # window would recompile)
-                nbatch += 1
-            trainer.end_round()         # close the throughput window
-            #                             before evals start
-            line = "[%d]" % (r + 1)
-            if self.task_eval_train:
-                line += trainer.train_metric_str("train")
-            for name, it in eval_iters:
-                line += trainer.evaluate(it, name)
-            if self.silent == 0 and is_root():
-                mon.line(line)
-            mon.maybe_stop_trace(r)
-            if monitored:
-                mon.emit("round_end", round=r,
-                         examples=trainer.last_round_examples,
-                         wall_s=trainer.last_round_wall_s,
-                         examples_per_sec=trainer
-                         .last_round_examples_per_sec)
-                mon.emit("memory", round=r, **device_memory_snapshot())
-                if io_hist is not None:
-                    mon.emit("io_wait", round=r, **io_hist.snapshot())
-                    io_hist.reset()
-                ps = pipeline_snapshot(itr_train)
-                if ps is not None:
-                    # per-round input-pipeline health: buffer-reuse
-                    # rate of the zero-copy assembly, H2D overlap of
-                    # the prefetch staging (doc/observability.md)
-                    mon.emit("pipeline", round=r, **ps)
-            if self.test_on_server:
-                # per-round weight consistency audit (the reference's
-                # test_on_server CheckWeight_, async_updater-inl.hpp:
-                # 149-154): every device replica must hold identical
-                # weights
-                trainer.check_weight_consistency()
-            if self.save_period and (r + 1) % self.save_period == 0:
-                # all ranks call (ZeRO-state gathers are collective);
-                # save_model writes on root only
-                trainer.save_model(self._model_path(r + 1))
+                    mon.emit("round_end", round=r,
+                             examples=trainer.last_round_examples,
+                             wall_s=trainer.last_round_wall_s,
+                             examples_per_sec=trainer
+                             .last_round_examples_per_sec)
+                    mon.emit("memory", round=r,
+                             **device_memory_snapshot())
+                    if io_hist is not None:
+                        mon.emit("io_wait", round=r,
+                                 **io_hist.snapshot())
+                        io_hist.reset()
+                    ps = pipeline_snapshot(itr_train)
+                    if ps is not None:
+                        # per-round input-pipeline health: buffer-reuse
+                        # rate of the zero-copy assembly, H2D overlap
+                        # of the prefetch staging (doc/observability.md)
+                        mon.emit("pipeline", round=r, **ps)
+                if self.test_on_server:
+                    # per-round weight consistency audit (the
+                    # reference's test_on_server CheckWeight_,
+                    # async_updater-inl.hpp:149-154): every device
+                    # replica must hold identical weights
+                    trainer.check_weight_consistency()
+                if self.save_period and (r + 1) % self.save_period == 0:
+                    # all ranks call (ZeRO-state gathers are
+                    # collective); only root commits, on the background
+                    # writer when checkpoint_async
+                    ckpt.save(r + 1)
+            # drain the writer before run_end: every checkpoint record
+            # lands in the stream, and the last commit is durable
+            # before the exit code says success
+            ckpt.close()
+        finally:
+            ckpt.close()
+            self._restore_handlers(handlers)
         if self.silent == 0 and is_root():
             mon.line("updating end, %ld sec in all"
                      % int(time.time() - start))

@@ -64,6 +64,17 @@ REQUIRED: Dict[str, tuple] = {
                       "timeouts", "errors", "latency_p50_ms",
                       "latency_p99_ms", "fill_rate", "pad_fraction",
                       "wall_s"),
+    # crash-safe checkpointing (doc/checkpointing.md): per-snapshot
+    # commit accounting (phase split shows the training thread paid
+    # only gather_ms when async), retention GC, the validated-resume
+    # decision, preemption exits, and recovered remote-read retries
+    "checkpoint": ("path", "counter", "status", "bytes", "digest",
+                   "gather_ms", "serialize_ms", "write_ms", "fsync_ms",
+                   "async_write", "emergency"),
+    "checkpoint_gc": ("removed", "kept"),
+    "resume": ("source", "counter", "scanned", "quarantined"),
+    "preempt": ("signal", "round", "exit_code"),
+    "stream_retry": ("uri", "what", "attempts"),
 }
 
 _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",
@@ -71,7 +82,8 @@ _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",
                 "consumer_wait_ms", "wall_s", "examples_per_sec",
                 "instances_per_sec", "queue_ms", "latency_ms",
                 "device_ms", "latency_p50_ms", "latency_p99_ms",
-                "rows_per_sec")
+                "rows_per_sec", "gather_ms", "serialize_ms",
+                "write_ms", "fsync_ms")
 
 # ratio fields must sit in [0, 1]
 _RATIO_KEYS = ("buffer_reuse_rate", "h2d_overlap_ratio", "fill_rate",

@@ -31,8 +31,6 @@ Semantics kept exactly:
 
 from __future__ import annotations
 
-import io
-import json
 import re
 import time
 from functools import partial
@@ -50,7 +48,6 @@ from ..parallel import (batch_sharding, make_mesh, opt_state_sharding,
 from ..updater import create_updater
 from ..utils.config import ConfigPairs
 from ..utils.metric import MetricSet
-from ..utils.stream import open_stream
 from .net import FuncNet
 
 _RE_METRIC = re.compile(r"^metric(?:\[([^\]]*)\])?$")
@@ -1452,7 +1449,13 @@ class NetTrainer:
 
     # -- checkpoint ------------------------------------------------------
 
-    def save_model(self, path: str) -> None:
+    def gather_snapshot(self) -> Tuple[Dict[str, np.ndarray], Dict]:
+        """Device->host gather of everything a snapshot holds, plus its
+        metadata — the only checkpoint phase that must run on the
+        training thread at an update boundary. Serialization and the
+        atomic commit live in :mod:`.checkpoint` and can run on a
+        background writer (CheckpointManager). Multi-process: the
+        optimizer-state gathers are collective — call on ALL ranks."""
         arrays: Dict[str, np.ndarray] = {}
         for lk, pt in self.params.items():
             for tag, w in pt.items():
@@ -1483,25 +1486,30 @@ class NetTrainer:
                     for k, v in st.items():
                         arrays["opt/%s/%s/%s" % (lk, tag, k)] = fetch(v)
         meta = {
-            "format_version": 1,
             "update_counter": self.update_counter,
             "structure": self.graph.to_dict(),
             "cfg": self.cfg,
         }
-        arrays["__meta__"] = np.frombuffer(
-            json.dumps(meta).encode(), np.uint8)
+        return arrays, meta
+
+    def save_model(self, path: str) -> None:
+        """Synchronous verified snapshot: gather, then atomically
+        commit with a content digest (checkpoint.write_snapshot). The
+        direct API raises on write failure; the train loop's managed
+        path (CheckpointManager) downgrades failures to warnings."""
+        from .checkpoint import write_snapshot
+        arrays, meta = self.gather_snapshot()
         # multi-process: every rank participates in the gathers above
         # (call save_model on ALL ranks); only root touches the file
         if jax.process_index() != 0:
             return
-        with open_stream(path, "wb") as f:
-            np.savez(f, **arrays)
+        write_snapshot(path, arrays, meta)
 
     def load_model(self, path: str) -> None:
-        # materialize while the stream is open (npz members load lazily)
-        with open_stream(path, "rb") as f:
-            blob = dict(np.load(f, allow_pickle=False))
-        meta = json.loads(bytes(blob["__meta__"]).decode())
+        # verified read: digest + format_version checked before any
+        # array is trusted (checkpoint.read_snapshot)
+        from .checkpoint import read_snapshot
+        blob, meta = read_snapshot(path)
         saved_graph = NetGraph.from_dict(meta["structure"])
         self._absorb_globals()
         # re-parse config against saved structure (Configure equality
@@ -1546,9 +1554,9 @@ class NetTrainer:
     def copy_model_from(self, path: str) -> None:
         """Finetune: copy weights for layers whose *names* match
         (nnet_impl-inl.hpp:117-150). Call after init_model."""
+        from .checkpoint import read_snapshot
         assert self._initialized
-        with open_stream(path, "rb") as f:
-            blob = dict(np.load(f, allow_pickle=False))
+        blob, _ = read_snapshot(path)
         copied = []
         for lk, pt in self.params.items():
             hit = {}

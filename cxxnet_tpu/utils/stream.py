@@ -13,32 +13,120 @@ config files, and every data iterator:
 * a scheme with no fsspec installed raises a clear error instead of a
   confusing FileNotFoundError;
 * tests (and users) can register custom schemes with
-  ``register_scheme`` without fsspec — the hook a mock filesystem uses.
+  ``register_scheme`` without fsspec — the hook a mock filesystem (and
+  the checkpoint fault-injection harness, ``utils/faultfs.py``) uses.
+
+Remote opens can be flaky on preemptible capacity (transient 5xx, DNS
+blips).  ``set_stream_retry`` turns on opt-in exponential-backoff
+retries for *read* opens of scheme URIs (the ``stream_retry`` config
+knob); writers never retry implicitly — the checkpoint layer owns write
+failure semantics (doc/checkpointing.md).
 """
 
 import builtins
 import os
+import random
 import re
-from typing import Callable, Dict
-
-# scheme -> open(path_without_scheme_prefixing_rules, mode) -> file obj.
-# Registered openers receive the FULL uri (scheme included) so they can
-# interpret it however the backing store wants.
-_SCHEMES: Dict[str, Callable] = {}
+import time
+from typing import Callable, Dict, Optional
 
 # 2+ chars so Windows drive letters ('C://...') stay local, as in
 # fsspec/dmlc
 _URI_RE = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]+)://")
 
 
-def register_scheme(scheme: str, opener: Callable) -> None:
+class _SchemeHooks:
+    """Registered handlers for one scheme: ``opener(uri, mode)`` is
+    required; ``lister(dir_uri) -> [basenames]`` and ``remover(uri)``
+    are optional (mock filesystems without them list empty / skip
+    deletes)."""
+
+    __slots__ = ("opener", "lister", "remover")
+
+    def __init__(self, opener: Callable,
+                 lister: Optional[Callable] = None,
+                 remover: Optional[Callable] = None):
+        self.opener = opener
+        self.lister = lister
+        self.remover = remover
+
+
+# scheme -> _SchemeHooks. Registered openers receive the FULL uri
+# (scheme included) so they can interpret it however the backing store
+# wants.
+_SCHEMES: Dict[str, _SchemeHooks] = {}
+
+# opt-in retry policy for transient remote-read failures (stream_retry)
+_RETRY = {"attempts": 0, "base_ms": 50.0, "max_ms": 2000.0}
+_RETRY_RECOVERED = 0       # process-lifetime count of retried-then-ok ops
+
+
+def register_scheme(scheme: str, opener: Callable,
+                    lister: Optional[Callable] = None,
+                    remover: Optional[Callable] = None) -> None:
     """Register ``opener(uri, mode) -> file-like`` for ``scheme://``
     URIs. Overrides fsspec for that scheme. Pass ``None`` to unregister.
+
+    ``lister(dir_uri) -> [basenames]`` (used by ``list_stream_dir``,
+    e.g. the continue=1 resume scan) and ``remover(uri)`` (used by
+    snapshot retention GC) are optional.
     """
     if opener is None:
         _SCHEMES.pop(scheme, None)
     else:
-        _SCHEMES[scheme] = opener
+        _SCHEMES[scheme] = _SchemeHooks(opener, lister, remover)
+
+
+def set_stream_retry(attempts: int, base_ms: float = 50.0,
+                     max_ms: float = 2000.0) -> None:
+    """Enable (attempts > 0) or disable retries for transient remote
+    read failures: exponential backoff ``base_ms * 2^k`` capped at
+    ``max_ms``, with uniform jitter in [0.5, 1.5)x. Local paths never
+    retry — a local IOError is not transient."""
+    _RETRY["attempts"] = max(0, int(attempts))
+    _RETRY["base_ms"] = float(base_ms)
+    _RETRY["max_ms"] = float(max_ms)
+
+
+def stream_retry_count() -> int:
+    """Process-lifetime number of operations that failed transiently
+    and then succeeded on retry (the telemetry counter)."""
+    return _RETRY_RECOVERED
+
+
+def _retrying(fn: Callable, uri: str, what: str):
+    """Run ``fn()`` under the configured retry policy. On eventual
+    success after >=1 failure, warn once and emit a ``stream_retry``
+    telemetry record so recovered flakiness stays observable."""
+    attempts = _RETRY["attempts"]
+    if attempts <= 0:
+        return fn()
+    tries = 0
+    while True:
+        try:
+            out = fn()
+        except (IOError, OSError) as e:
+            tries += 1
+            if tries > attempts:
+                raise
+            delay = min(_RETRY["max_ms"],
+                        _RETRY["base_ms"] * (2 ** (tries - 1))) / 1e3
+            time.sleep(delay * (0.5 + random.random()))
+            continue
+        if tries:
+            global _RETRY_RECOVERED
+            _RETRY_RECOVERED += 1
+            from ..monitor import get_global, warn_once
+            warn_once("stream_retry",
+                      "transient %s failure on %r recovered after %d "
+                      "retr%s (stream_retry=%d)"
+                      % (what, uri, tries, "y" if tries == 1 else "ies",
+                         attempts))
+            mon = get_global()
+            if mon is not None and mon.enabled:
+                mon.emit("stream_retry", uri=uri, what=what,
+                         attempts=tries)
+        return out
 
 
 def uri_scheme(uri: str) -> str:
@@ -59,14 +147,7 @@ def local_path(uri: str) -> str:
     return uri[7:] if uri.lower().startswith("file://") else uri
 
 
-def open_stream(uri: str, mode: str = "rb"):
-    """Open ``uri`` for reading or writing; returns a file-like object.
-
-    The single entry point all framework I/O goes through (reference:
-    dmlc ``Stream::Create``, used for model_in/model_dir and iterator
-    paths). Local paths open natively; ``scheme://`` URIs dispatch to a
-    registered opener or fsspec.
-    """
+def _open_raw(uri: str, mode: str):
     scheme = uri_scheme(uri)
     if scheme == "":
         path = local_path(uri)
@@ -76,7 +157,7 @@ def open_stream(uri: str, mode: str = "rb"):
                 os.makedirs(d, exist_ok=True)
         return builtins.open(path, mode)
     if scheme in _SCHEMES:
-        return _SCHEMES[scheme](uri, mode)
+        return _SCHEMES[scheme].opener(uri, mode)
     try:
         import fsspec
         return fsspec.open(uri, mode).open()
@@ -88,13 +169,45 @@ def open_stream(uri: str, mode: str = "rb"):
                                                 scheme))
 
 
+def open_stream(uri: str, mode: str = "rb"):
+    """Open ``uri`` for reading or writing; returns a file-like object.
+
+    The single entry point all framework I/O goes through (reference:
+    dmlc ``Stream::Create``, used for model_in/model_dir and iterator
+    paths). Local paths open natively; ``scheme://`` URIs dispatch to a
+    registered opener or fsspec. Read opens of scheme URIs honor the
+    opt-in ``set_stream_retry`` policy (missing objects raise whatever
+    the backend raises — FileNotFoundError subclasses OSError, so a
+    retry policy will re-probe a missing remote object before giving
+    up; that is the desired behavior on eventually-consistent stores).
+    """
+    if uri_scheme(uri) and not any(c in mode for c in "wa+"):
+        return _retrying(lambda: _open_raw(uri, mode), uri, "open")
+    return _open_raw(uri, mode)
+
+
+def read_stream_bytes(uri: str) -> bytes:
+    """Read the full contents of ``uri``. For scheme URIs the whole
+    open+read is one retryable unit under the ``set_stream_retry``
+    policy (a read() that dies mid-stream re-opens from the start —
+    the caller gets complete bytes or an exception, never a torn
+    prefix). The checkpoint loader reads snapshots through this."""
+    def _do():
+        with _open_raw(uri, "rb") as f:
+            return f.read()
+    if uri_scheme(uri):
+        return _retrying(_do, uri, "read")
+    return _do()
+
+
 def list_stream_dir(uri: str):
     """List entry basenames of a directory URI; [] if it doesn't exist.
 
-    Local paths use os.listdir; scheme:// URIs use the fsspec
-    filesystem (registered mock schemes without a lister return []).
-    Used by continue=1 resume to find the newest snapshot in a possibly
-    remote model_dir (reference cxxnet_main.cpp:180-202).
+    Local paths use os.listdir; scheme:// URIs use the registered
+    lister when one exists, else the fsspec filesystem (registered
+    schemes without a lister return []). Used by continue=1 resume to
+    find the newest snapshot in a possibly remote model_dir (reference
+    cxxnet_main.cpp:180-202).
     """
     scheme = uri_scheme(uri)
     if scheme == "":
@@ -102,6 +215,11 @@ def list_stream_dir(uri: str):
         if not os.path.isdir(path):
             return []
         return os.listdir(path)
+    if scheme in _SCHEMES:
+        hooks = _SCHEMES[scheme]
+        if hooks.lister is None:
+            return []
+        return list(hooks.lister(uri))
     try:
         import fsspec
         fs, root = fsspec.core.url_to_fs(uri)
@@ -118,6 +236,36 @@ def list_stream_dir(uri: str):
         return []
 
 
+def remove_stream(uri: str) -> bool:
+    """Delete ``uri`` if the backend supports it; True on success,
+    False when the object is missing or the scheme has no remover.
+    Used by snapshot retention GC — a failed delete must never kill a
+    training run, so this swallows per-object errors into False."""
+    scheme = uri_scheme(uri)
+    if scheme == "":
+        try:
+            os.remove(local_path(uri))
+            return True
+        except OSError:
+            return False
+    if scheme in _SCHEMES:
+        hooks = _SCHEMES[scheme]
+        if hooks.remover is None:
+            return False
+        try:
+            hooks.remover(uri)
+            return True
+        except (IOError, OSError, KeyError):
+            return False
+    try:
+        import fsspec
+        fs, root = fsspec.core.url_to_fs(uri)
+        fs.rm(root)
+        return True
+    except Exception:
+        return False
+
+
 def stream_exists(uri: str) -> bool:
     """True if ``uri`` names an existing file (local stat or a
     successful remote open)."""
@@ -125,7 +273,7 @@ def stream_exists(uri: str) -> bool:
     if scheme == "":
         return os.path.exists(local_path(uri))
     try:
-        with open_stream(uri, "rb"):
+        with _open_raw(uri, "rb"):
             return True
     except (IOError, OSError):
         return False

@@ -1,0 +1,559 @@
+"""Crash-safe checkpointing (nnet/checkpoint.py, doc/checkpointing.md):
+the fault matrix, end-to-end.
+
+Every failure mode the subsystem claims to survive is injected here —
+torn local commits, zero-byte/truncated snapshots handed to continue=1,
+ENOSPC mid-serialize, digest corruption, a manifest-less remote payload
+(the remote torn commit), SIGTERM mid-round — plus the positive paths:
+async commit overlap, retention GC, format-version gating, stream
+retries, multi-rank root-only writes, and the offline verifier tool.
+"""
+
+import io
+import json
+import os
+import signal
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from cxxnet_tpu.main import EXIT_PREEMPTED, main
+from cxxnet_tpu.monitor import MemorySink, Monitor, set_global
+from cxxnet_tpu.monitor.schema import read_jsonl, validate_records
+from cxxnet_tpu.nnet.checkpoint import (CheckpointManager,
+                                        SnapshotFormatError,
+                                        SnapshotIntegrityError,
+                                        compute_digest,
+                                        find_latest_valid,
+                                        read_snapshot, retention_sweep,
+                                        scan_snapshots, verify_snapshot,
+                                        write_snapshot)
+from cxxnet_tpu.nnet.trainer import NetTrainer
+from cxxnet_tpu.utils.config import parse_config
+from cxxnet_tpu.utils.faultfs import FaultFS
+from cxxnet_tpu.utils.stream import (open_stream, register_scheme,
+                                     set_stream_retry)
+from tests.test_trainer import MLP_CONF, make_iters, make_trainer, \
+    synth_idx
+
+
+@pytest.fixture
+def faultfs():
+    fs = FaultFS("fault").install()
+    try:
+        yield fs
+    finally:
+        fs.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _reset_retry():
+    yield
+    set_stream_retry(0)
+    set_global(None)
+
+
+def trained_trainer(tmp_path):
+    tr, te = make_iters(tmp_path)
+    t = make_trainer()
+    for batch in tr:
+        t.update(batch)
+    tr.close()
+    te.close()
+    return t
+
+
+def write_conf(tmp_path, model_dir=None, extra=""):
+    pimg, plab = synth_idx(str(tmp_path), n=200, name="tr")
+    conf = """
+data = train
+iter = mnist
+  path_img = "%s"
+  path_label = "%s"
+  silent = 1
+iter = end
+%s
+input_shape = 1,1,256
+batch_size = 50
+eta = 0.1
+metric[label] = error
+num_round = 2
+save_model = 1
+model_dir = "%s"
+print_step = 0
+eval_train = 0
+%s
+""" % (pimg, plab, MLP_CONF.split("input_shape")[0],
+       model_dir or str(tmp_path / "models"), extra)
+    p = str(tmp_path / "ckpt_run.conf")
+    with open(p, "w") as f:
+        f.write(conf)
+    return p
+
+
+# -- atomic local commit --------------------------------------------------
+
+
+def test_save_is_atomic_and_digested(tmp_path):
+    t = trained_trainer(tmp_path)
+    path = str(tmp_path / "m" / "0001.model.npz")
+    t.save_model(path)
+    assert os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")
+    blob = dict(np.load(path, allow_pickle=False))
+    meta = json.loads(bytes(blob["__meta__"]).decode())
+    assert meta["format_version"] == 2
+    assert meta["content_digest"] == compute_digest(blob)
+    # and the verified loader round-trips it
+    t2 = NetTrainer(parse_config(MLP_CONF))
+    t2.load_model(path)
+    assert t2.update_counter == t.update_counter
+
+
+def test_kill_between_tmp_write_and_rename_is_invisible(tmp_path):
+    """A kill -9 between the tmp write and the rename leaves only a
+    .tmp sibling; resume never sees it and the scan sweeps it."""
+    t = trained_trainer(tmp_path)
+    mdir = str(tmp_path / "m")
+    t.save_model(os.path.join(mdir, "0001.model.npz"))
+    # the torn state a kill leaves: a partial tmp for the NEXT counter
+    tmp = os.path.join(mdir, "0002.model.npz.tmp")
+    with open(os.path.join(mdir, "0001.model.npz"), "rb") as f:
+        partial = f.read()[:1000]
+    with open(tmp, "wb") as f:
+        f.write(partial)
+    rep = find_latest_valid(mdir)
+    assert rep.counter == 1
+    assert rep.quarantined == []
+    assert not os.path.exists(tmp)       # stale tmp swept
+
+
+def test_continue_skips_zero_byte_and_truncated_newest(tmp_path,
+                                                       capsys):
+    """The pre-existing _latest_snapshot crash (ISSUE 5 satellite 1):
+    continue=1 must never hand an unvalidated path to load_model."""
+    conf = write_conf(tmp_path)
+    assert main([conf]) == 0
+    mdir = tmp_path / "models"
+    good = sorted(os.listdir(mdir))
+    assert good == ["0001.model.npz", "0002.model.npz"]
+    # a crash mid-write under the OLD writer: zero-byte + truncated
+    (mdir / "0003.model.npz").write_bytes(b"")
+    (mdir / "0004.model.npz").write_bytes(
+        (mdir / "0002.model.npz").read_bytes()[:512])
+    assert main([conf, "continue=1", "num_round=4"]) == 0
+    names = sorted(os.listdir(mdir))
+    # resumed from 0002 (rounds 3 and 4 trained and re-committed
+    # fresh 0003/0004 snapshots), corpses quarantined out of the way
+    assert "0003.model.npz.quarantined" in names
+    assert "0004.model.npz.quarantined" in names
+    for n in ("0003.model.npz", "0004.model.npz"):
+        assert verify_snapshot(str(mdir / n))["ok"]
+    err = capsys.readouterr().err
+    assert "quarantined" in err
+
+
+def test_continue_all_corrupt_starts_fresh_with_warning(tmp_path,
+                                                        capsys):
+    conf = write_conf(tmp_path)
+    mdir = tmp_path / "models"
+    mdir.mkdir()
+    (mdir / "0005.model.npz").write_bytes(b"not an npz")
+    assert main([conf, "continue=1"]) == 0
+    assert "0001.model.npz" in os.listdir(mdir)   # fresh from round 0
+    assert "resume_no_valid_snapshot" in capsys.readouterr().err
+
+
+# -- format versioning ----------------------------------------------------
+
+
+def _rewrite_meta(path, mutate):
+    blob = dict(np.load(path, allow_pickle=False))
+    meta = json.loads(bytes(blob["__meta__"]).decode())
+    mutate(meta)
+    blob["__meta__"] = np.frombuffer(json.dumps(meta).encode(),
+                                     np.uint8)
+    with open(path, "wb") as f:
+        np.savez(f, **blob)
+
+
+def test_future_format_version_raises_clearly(tmp_path):
+    t = trained_trainer(tmp_path)
+    path = str(tmp_path / "0001.model.npz")
+    t.save_model(path)
+    _rewrite_meta(path, lambda m: m.update(format_version=99))
+    t2 = NetTrainer(parse_config(MLP_CONF))
+    with pytest.raises(SnapshotFormatError, match="format_version 99"):
+        t2.load_model(path)
+
+
+def test_v1_snapshot_without_digest_still_loads(tmp_path, capsys):
+    """Backward direction: pre-subsystem snapshots (format_version 1,
+    no content_digest) resume with a warn-once, not a crash."""
+    t = trained_trainer(tmp_path)
+    path = str(tmp_path / "0001.model.npz")
+    t.save_model(path)
+    _rewrite_meta(path, lambda m: (m.pop("content_digest"),
+                                   m.update(format_version=1)))
+    t2 = NetTrainer(parse_config(MLP_CONF))
+    t2.load_model(path)                   # no digest -> unverified load
+    assert t2.update_counter == t.update_counter
+    rep = verify_snapshot(path)
+    assert rep["ok"] and rep["digest"] == "missing"
+
+
+# -- digest corruption ----------------------------------------------------
+
+
+def _corrupt_array(path):
+    blob = dict(np.load(path, allow_pickle=False))
+    key = sorted(k for k in blob if k.startswith("param/"))[0]
+    arr = np.array(blob[key])
+    arr.flat[0] += 1.0
+    blob[key] = arr
+    with open(path, "wb") as f:
+        np.savez(f, **blob)
+
+
+def test_digest_mismatch_rejected_and_resume_falls_back(tmp_path):
+    t = trained_trainer(tmp_path)
+    mdir = str(tmp_path / "m")
+    t.save_model(os.path.join(mdir, "0001.model.npz"))
+    t.save_model(os.path.join(mdir, "0002.model.npz"))
+    _corrupt_array(os.path.join(mdir, "0002.model.npz"))
+    with pytest.raises(SnapshotIntegrityError, match="digest"):
+        NetTrainer(parse_config(MLP_CONF)).load_model(
+            os.path.join(mdir, "0002.model.npz"))
+    mon = Monitor(MemorySink())
+    rep = find_latest_valid(mdir, monitor=mon)
+    assert rep.counter == 1
+    assert rep.quarantined == ["0002.model.npz"]
+    assert os.path.exists(
+        os.path.join(mdir, "0002.model.npz.quarantined"))
+
+
+# -- fault injection: ENOSPC / torn remote commit -------------------------
+
+
+def test_enospc_mid_serialize_direct_api_raises(tmp_path, faultfs):
+    t = trained_trainer(tmp_path)
+    faultfs.enospc_after = 4096
+    with pytest.raises(OSError, match="space"):
+        t.save_model("fault://ckpt/0001.model.npz")
+    assert faultfs.store == {}            # nothing half-committed
+
+
+def test_enospc_managed_save_warns_and_training_survives(tmp_path,
+                                                         faultfs,
+                                                         capsys):
+    """A full disk mid-snapshot must not kill a training run: the
+    managed path downgrades the failure to a warning + telemetry."""
+    conf = write_conf(tmp_path, model_dir="fault://ckpt",
+                      extra="monitor = jsonl\nmonitor_path = %s\n"
+                            % (tmp_path / "mon.jsonl"))
+    faultfs.enospc_after = 4096
+    assert main([conf]) == 0              # run completes
+    assert not scan_snapshots("fault://ckpt")
+    assert "checkpoint_write_failed" in capsys.readouterr().err
+    recs = read_jsonl(str(tmp_path / "mon.jsonl"))
+    validate_records(recs)
+    cps = [r for r in recs if r["event"] == "checkpoint"]
+    assert cps and all(r["status"] == "failed" for r in cps)
+    assert all("space" in r["error"] for r in cps)
+
+
+def test_remote_payload_without_manifest_is_uncommitted(tmp_path,
+                                                        faultfs):
+    """Remote torn commit: the writer died between the payload and the
+    .ok manifest — resume must treat the payload as uncommitted."""
+    t = trained_trainer(tmp_path)
+    t.save_model("fault://ckpt/0001.model.npz")
+    assert scan_snapshots("fault://ckpt") == [(1, "0001.model.npz")]
+    faultfs.fail_write_substr = ".ok"
+    with pytest.raises(IOError, match="injected write failure"):
+        t.save_model("fault://ckpt/0002.model.npz")
+    faultfs.clear_faults()
+    assert "fault://ckpt/0002.model.npz" in faultfs.store  # payload..
+    rep = find_latest_valid("fault://ckpt")   # ..but not committed
+    assert rep.counter == 1
+
+
+def test_remote_rewrite_drops_manifest_before_payload(tmp_path,
+                                                      faultfs):
+    """Re-committing an already-committed counter (emergency snapshots
+    reuse the in-progress round's number): the old manifest must be
+    gone BEFORE the payload is overwritten, so a kill mid-overwrite
+    leaves an uncommitted payload — never a torn payload a stale
+    manifest still vouches for."""
+    t = trained_trainer(tmp_path)
+    t.save_model("fault://rw/0001.model.npz")
+    faultfs.fail_write_substr = "0001.model.npz"   # die at the payload
+    with pytest.raises(IOError, match="injected write failure"):
+        t.save_model("fault://rw/0001.model.npz")
+    faultfs.clear_faults()
+    # old payload bytes survive but the commit marker is gone:
+    # uncommitted, not committed-but-torn
+    assert "fault://rw/0001.model.npz" in faultfs.store
+    assert "fault://rw/0001.model.npz.ok" not in faultfs.store
+    assert scan_snapshots("fault://rw") == []
+
+
+def test_scan_snapshots_is_read_only_for_inflight_tmp(tmp_path):
+    """tools/ckpt_verify.py may be pointed at a model_dir a live run
+    is committing into: scan_snapshots must never delete its in-flight
+    .tmp (only the resume scan, which owns the dir, sweeps them)."""
+    t = trained_trainer(tmp_path)
+    mdir = str(tmp_path / "m")
+    t.save_model(os.path.join(mdir, "0001.model.npz"))
+    tmp = os.path.join(mdir, "0002.model.npz.tmp")
+    with open(tmp, "wb") as f:
+        f.write(b"in-flight")
+    assert scan_snapshots(mdir) == [(1, "0001.model.npz")]
+    assert os.path.exists(tmp)            # untouched by a bare scan
+    import tools.ckpt_verify as cv
+    assert cv.main([mdir, "--quiet"]) == 0
+    assert os.path.exists(tmp)            # and by the offline verifier
+    rep = find_latest_valid(mdir)         # resume DOES sweep it
+    assert rep.counter == 1
+    assert not os.path.exists(tmp)
+
+
+def test_remote_torn_payload_detected_by_manifest(tmp_path, faultfs):
+    """A torn write that still produced a commit manifest (buffered
+    remote store ack'd short): manifest size check catches it and the
+    resume scan quarantine-marks it."""
+    t = trained_trainer(tmp_path)
+    t.save_model("fault://ckpt/0001.model.npz")
+    t.save_model("fault://ckpt/0002.model.npz")
+    uri = "fault://ckpt/0002.model.npz"
+    faultfs.store[uri] = faultfs.store[uri][:-2048]   # torn payload
+    rep2 = verify_snapshot("fault://ckpt/0002.model.npz")
+    assert not rep2["ok"] and "size mismatch" in rep2["error"]
+    rep = find_latest_valid("fault://ckpt")
+    assert rep.counter == 1
+    assert rep.quarantined == ["0002.model.npz"]
+    # the quarantine marker persists across scans
+    assert "fault://ckpt/0002.model.npz.quarantined" in faultfs.store
+    assert scan_snapshots("fault://ckpt") == [(1, "0001.model.npz")]
+
+
+def test_continue_resumes_from_fake_remote_model_dir(tmp_path,
+                                                     faultfs):
+    """End-to-end over a registered remote scheme: train, corrupt the
+    newest committed snapshot, continue=1 resumes from the survivor."""
+    conf = write_conf(tmp_path, model_dir="fault://run")
+    assert main([conf]) == 0
+    assert [c for c, _ in scan_snapshots("fault://run")] == [2, 1]
+    # corrupt the newest committed payload (manifest left matching in
+    # size: flip bytes, not length — digest must catch it)
+    uri = "fault://run/0002.model.npz"
+    data = bytearray(faultfs.store[uri])
+    data[len(data) // 2] ^= 0xFF
+    faultfs.store[uri] = bytes(data)
+    assert main([conf, "continue=1", "num_round=3"]) == 0
+    # resumed from 0001 -> re-ran rounds 2 and 3 and committed both
+    assert [c for c, _ in scan_snapshots("fault://run")] == [3, 2, 1]
+    rep = verify_snapshot("fault://run/0002.model.npz")
+    assert rep["ok"]                      # rewritten, valid again
+
+
+# -- async writer ---------------------------------------------------------
+
+
+def test_async_save_returns_before_commit(tmp_path):
+    """The training thread pays only the gather: save() returns while
+    the commit is still gated; close() drains it."""
+    store = {}
+    gate = threading.Event()
+
+    class _GatedFile(io.BytesIO):
+        def __init__(self, uri):
+            super().__init__()
+            self._uri = uri
+
+        def close(self):
+            gate.wait(timeout=30)
+            store[self._uri] = self.getvalue()
+            super().close()
+
+    def _gated_open(uri, mode):
+        f = _GatedFile(uri)
+        return f if "b" in mode else io.TextIOWrapper(f)
+
+    register_scheme("gated", _gated_open)
+    try:
+        t = trained_trainer(tmp_path)
+        sink = MemorySink()
+        ckpt = CheckpointManager(
+            t, lambda c: "gated://m/%04d.model.npz" % c,
+            model_dir="gated://m", monitor=Monitor(sink), async_=True)
+        ckpt.save(1)
+        assert store == {}                # commit still in flight
+        gate.set()
+        ckpt.close()
+        assert "gated://m/0001.model.npz" in store
+        recs = [r for r in sink.records if r["event"] == "checkpoint"]
+        assert len(recs) == 1
+        r = recs[0]
+        assert r["status"] == "ok" and r["async_write"] is True
+        assert r["gather_ms"] >= 0 and r["serialize_ms"] >= 0
+        validate_records(sink.records)
+    finally:
+        register_scheme("gated", None)
+
+
+def test_multi_rank_save_only_root_touches_file(tmp_path,
+                                                monkeypatch):
+    t = trained_trainer(tmp_path)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    path = str(tmp_path / "rank1" / "0001.model.npz")
+    t.save_model(path)                    # non-root: gathers only
+    assert not os.path.exists(os.path.dirname(path))
+    ckpt = CheckpointManager(t, lambda c: path)
+    ckpt.save(1)
+    ckpt.close()
+    assert not os.path.exists(os.path.dirname(path))
+
+
+# -- retention ------------------------------------------------------------
+
+
+def test_keep_snapshots_gc(tmp_path):
+    conf = write_conf(tmp_path, extra="keep_snapshots = 2\n")
+    assert main([conf, "num_round=5"]) == 0
+    mdir = tmp_path / "models"
+    assert sorted(os.listdir(mdir)) == ["0004.model.npz",
+                                        "0005.model.npz"]
+
+
+def test_retention_sweep_remote_removes_manifest_first(faultfs,
+                                                       tmp_path):
+    t = trained_trainer(tmp_path)
+    for c in (1, 2, 3):
+        t.save_model("fault://gc/%04d.model.npz" % c)
+    removed = retention_sweep("fault://gc", keep=1)
+    assert removed == ["0002.model.npz", "0001.model.npz"]
+    assert set(faultfs.store) == {"fault://gc/0003.model.npz",
+                                  "fault://gc/0003.model.npz.ok"}
+    assert retention_sweep("fault://gc", keep=0) == []   # 0 = keep all
+
+
+# -- preemption -----------------------------------------------------------
+
+
+def test_sigterm_triggers_emergency_snapshot_and_resume(tmp_path,
+                                                        monkeypatch,
+                                                        capsys):
+    """SIGTERM mid-round: emergency snapshot at the update boundary,
+    schema-valid preempt telemetry, EXIT_PREEMPTED, and continue=1
+    resumes from the emergency snapshot."""
+    mon_path = str(tmp_path / "mon.jsonl")
+    conf = write_conf(
+        tmp_path,
+        extra="dispatch_period = 1\nmonitor = jsonl\n"
+              "monitor_path = %s\n" % mon_path)
+    calls = {"n": 0}
+    orig = NetTrainer.update
+
+    def patched(self, batch):
+        out = orig(self, batch)
+        calls["n"] += 1
+        if calls["n"] == 3:               # mid-round 0 (4 batches/rd)
+            signal.raise_signal(signal.SIGTERM)
+        return out
+
+    monkeypatch.setattr(NetTrainer, "update", patched)
+    rc = main([conf, "num_round=100000"])
+    assert rc == EXIT_PREEMPTED
+    monkeypatch.setattr(NetTrainer, "update", orig)
+    mdir = tmp_path / "models"
+    assert os.listdir(mdir) == ["0000.model.npz"]
+    assert verify_snapshot(str(mdir / "0000.model.npz"))["ok"]
+    recs = read_jsonl(mon_path)
+    validate_records(recs)
+    pre = [r for r in recs if r["event"] == "preempt"]
+    assert len(pre) == 1
+    assert pre[0]["signal"] == int(signal.SIGTERM)
+    assert pre[0]["exit_code"] == EXIT_PREEMPTED
+    cps = [r for r in recs if r["event"] == "checkpoint"]
+    assert cps[-1]["emergency"] is True
+    assert "preempted by signal" in capsys.readouterr().out
+    # the run's SIGTERM handler was restored on exit
+    assert signal.getsignal(signal.SIGTERM) in (
+        signal.SIG_DFL, signal.default_int_handler)
+    # and the emergency snapshot resumes: re-runs round 0 onward
+    assert main([conf, "continue=1", "num_round=1"]) == 0
+    assert "0001.model.npz" in os.listdir(mdir)
+
+
+# -- stream retry ---------------------------------------------------------
+
+
+def test_stream_retry_recovers_transient_open_failures(faultfs,
+                                                       capsys):
+    faultfs.store["fault://d/x.bin"] = b"payload"
+    sink = MemorySink()
+    set_global(Monitor(sink))
+    faultfs.fail_opens = 2
+    set_stream_retry(0)
+    with pytest.raises(IOError):          # opt-in: off fails fast
+        open_stream("fault://d/x.bin", "rb")
+    faultfs.fail_opens = 2
+    set_stream_retry(3, base_ms=1.0)
+    with open_stream("fault://d/x.bin", "rb") as f:
+        assert f.read() == b"payload"
+    assert "stream_retry" in capsys.readouterr().err    # warn-once
+    recs = [r for r in sink.records if r["event"] == "stream_retry"]
+    assert recs and recs[0]["attempts"] == 2
+    validate_records(sink.records)
+    # exhausted retries still raise
+    faultfs.fail_opens = 10
+    with pytest.raises(IOError):
+        open_stream("fault://d/x.bin", "rb")
+
+
+def test_stream_retry_covers_snapshot_reads(faultfs, tmp_path):
+    t = trained_trainer(tmp_path)
+    t.save_model("fault://d/0001.model.npz")
+    set_stream_retry(3, base_ms=1.0)
+    faultfs.fail_reads = 2                # die mid-read, twice
+    blob, meta = read_snapshot("fault://d/0001.model.npz")
+    assert meta["content_digest"] == compute_digest(blob)
+
+
+# -- offline verifier tool ------------------------------------------------
+
+
+def test_ckpt_verify_tool(tmp_path, faultfs, capsys):
+    import tools.ckpt_verify as cv
+    t = trained_trainer(tmp_path)
+    mdir = str(tmp_path / "m")
+    t.save_model(os.path.join(mdir, "0001.model.npz"))
+    t.save_model(os.path.join(mdir, "0002.model.npz"))
+    assert cv.main([mdir]) == 0
+    out = capsys.readouterr().out
+    assert out.count("OK") == 2 and "0 corrupt" in out
+    _corrupt_array(os.path.join(mdir, "0002.model.npz"))
+    assert cv.main([mdir]) == 1
+    assert "digest mismatch" in capsys.readouterr().out
+    assert cv.main([os.path.join(mdir, "0001.model.npz")]) == 0
+    capsys.readouterr()
+    # remote: committed-good passes, manifest-less payload is reported
+    # as uncommitted, not corruption
+    t.save_model("fault://v/0001.model.npz")
+    del faultfs.store["fault://v/0001.model.npz.ok"]
+    t.save_model("fault://v/0002.model.npz")
+    assert cv.main(["fault://v"]) == 0
+    assert "UNCOMMITTED" in capsys.readouterr().out
+    faultfs.truncate_tail = 512
+    t.save_model("fault://v/0003.model.npz")
+    faultfs.clear_faults()
+    assert cv.main(["fault://v"]) == 1
+    capsys.readouterr()
+    # a missing/deleted remote snapshot URI is an unreadable FILE
+    # (exit 1), never an empty dir's false all-clear
+    assert cv.main(["fault://v/0099.model.npz"]) == 1
+    assert "CORRUPT" in capsys.readouterr().out

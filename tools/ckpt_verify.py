@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Offline snapshot integrity checker — the operator's first debugging
+step when a resume misbehaves (doc/checkpointing.md).
+
+For each argument (a snapshot file, or a model_dir to scan — local
+path or remote URI, anything the stream layer opens) it reports
+structural loadability, the content digest verdict, the format
+version, and (remote) the commit-manifest cross-check::
+
+    python tools/ckpt_verify.py ./models
+    python tools/ckpt_verify.py gs://bucket/run7/0042.model.npz
+
+Exit status: 0 = every checked snapshot verifies; 1 = at least one is
+corrupt, truncated, digest-mismatched, or format-incompatible (an
+empty model_dir is not corruption); 2 = usage error. The fault-matrix
+tests drive this binary against injected ENOSPC/truncation/torn-commit
+states, so its verdicts are pinned behavior, not best-effort output.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from cxxnet_tpu.nnet.checkpoint import (MODEL_RE, scan_snapshots,
+                                        snapshot_uri, verify_snapshot)
+from cxxnet_tpu.utils.stream import (list_stream_dir, stream_exists,
+                                     uri_scheme)
+
+
+def _is_dir(target: str) -> bool:
+    if uri_scheme(target):
+        # object stores have no real dirs: a URI whose basename looks
+        # like a snapshot is ALWAYS checked as a file — a missing one
+        # must report CORRUPT/unreadable (exit 1), never read as an
+        # empty dir (exit 0, a false all-clear on a vanished
+        # snapshot). Anything else is a dir unless it opens.
+        if MODEL_RE.match(target.rsplit("/", 1)[-1]):
+            return False
+        return not stream_exists(target)
+    return os.path.isdir(target)
+
+
+def _check(path: str, quiet: bool) -> bool:
+    rep = verify_snapshot(path)
+    if rep["ok"]:
+        if not quiet:
+            print("OK       %s  (%d bytes, format v%d, digest %s)"
+                  % (path, rep["bytes"], rep["format_version"],
+                     rep["digest"]))
+        return True
+    print("CORRUPT  %s  (%s)" % (path, rep["error"]))
+    return False
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ckpt_verify",
+        description="verify snapshot integrity (digest + structural "
+                    "loadability), local or remote")
+    ap.add_argument("targets", nargs="+",
+                    help="snapshot files and/or model_dir paths/URIs")
+    ap.add_argument("--quiet", action="store_true",
+                    help="print corrupt snapshots only")
+    args = ap.parse_args(argv)
+
+    checked = 0
+    bad = 0
+    for target in args.targets:
+        if _is_dir(target):
+            names = [n for _, n in scan_snapshots(target)]
+            # uncommitted remote payloads (no .ok) are *reported* but
+            # not counted as corruption: resume ignores them by design
+            listing = set(list_stream_dir(target))
+            if uri_scheme(target):
+                for n in sorted(listing):
+                    if MODEL_RE.match(n) and n + ".ok" not in listing:
+                        print("UNCOMMITTED %s  (payload without "
+                              "commit manifest; resume ignores it)"
+                              % snapshot_uri(target, n))
+            if not names and not args.quiet:
+                print("EMPTY    %s  (no committed snapshots)" % target)
+            for n in names:
+                checked += 1
+                if not _check(snapshot_uri(target, n), args.quiet):
+                    bad += 1
+        else:
+            checked += 1
+            if not _check(target, args.quiet):
+                bad += 1
+    if not args.quiet:
+        print("checked %d snapshot(s), %d corrupt" % (checked, bad))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
