@@ -294,6 +294,12 @@ class LearnTask:
                         for k, v in pairs]
 
             batch_cfg = _localize(batch_cfg)
+            if self.task == "serve_fleet":
+                # the fleet front end serves network traffic, not an
+                # iterator — skip data-block construction entirely (a
+                # deployment config's train blocks may point at paths
+                # the serving host does not mount)
+                return self._task_serve_fleet(cfg)
             if (self.task in _PRED_TASKS and not self.test_io
                     and not any(b["kind"] == "pred" for b in blocks)):
                 # no 'pred =' block: these tasks fall back to the train
@@ -663,6 +669,53 @@ class LearnTask:
         if mon.enabled:
             mon.emit("task_end", task="serve", requests=agg["ok"],
                      rows=summary["rows"])
+        return 0
+
+    def _task_serve_fleet(self, cfg) -> int:
+        """Fleet serving (doc/serving.md "Fleet serving"): N routed
+        engines with per-tenant quotas and checkpoint-driven hot-swap
+        behind the HTTP/JSON + binary protocol listeners. Runs for
+        ``serve_fleet_duration_s`` seconds (0 = until SIGTERM/SIGINT —
+        the deployment mode), then drains every engine cleanly."""
+        assert world_size() == 1, \
+            "task=serve_fleet must run single-process"
+        from .serve import FleetServer
+        mon = self._mon
+        if mon.enabled:
+            mon.emit("run_start",
+                     **run_metadata("serve_fleet", self._cfg_stream))
+        fleet = FleetServer(cfg, monitor=mon)
+        handlers = []
+        try:
+            fleet.start()
+            mon.line("serve_fleet: listening http=%s binary=%s, "
+                     "models: %s"
+                     % (fleet.http_port, fleet.binary_port,
+                        ", ".join("%s@%04d" % (d["model"], d["counter"])
+                                  for d in fleet.describe())))
+            handlers = self._install_preempt_handlers()
+            dur = fleet.fleet_cfg.duration_s
+            deadline = time.monotonic() + dur if dur > 0 else None
+            while self._preempt_signum is None:
+                if deadline is not None \
+                        and time.monotonic() >= deadline:
+                    break
+                time.sleep(0.05)
+            summary = fleet.close()
+        finally:
+            # a failure between start and close must still stop the
+            # listener/watcher threads and drain the engines (close is
+            # idempotent; no-op on the success path)
+            fleet.close(drain=False)
+            self._restore_handlers(handlers)
+        c = summary["requests"]
+        mon.line("serve_fleet: %d requests (%d ok / %d over_quota / "
+                 "%d busy / %d timeout / %d error), %d hot-swaps"
+                 % (c["requests"], c["ok"], c["over_quota"], c["busy"],
+                    c["timeout"], c["error"], summary["swaps"]))
+        if mon.enabled:
+            mon.emit("task_end", task="serve_fleet",
+                     requests=c["requests"], swaps=summary["swaps"])
         return 0
 
     def _task_predict(self, trainer, itr) -> int:

@@ -64,6 +64,14 @@ REQUIRED: Dict[str, tuple] = {
                       "timeouts", "errors", "latency_p50_ms",
                       "latency_p99_ms", "fill_rate", "pad_fraction",
                       "wall_s"),
+    # fleet serving (doc/serving.md "Fleet serving"): per-request
+    # protocol outcome (both HTTP and binary funnel through one core),
+    # per-tenant quota sheds, and checkpoint-driven hot-swaps
+    "serve_http": ("protocol", "status", "model", "tenant", "rows",
+                   "latency_ms"),
+    "tenant_shed": ("tenant", "model", "rows", "rate", "burst"),
+    "hot_swap": ("model", "old_counter", "new_counter", "path",
+                 "warmup_programs", "old_requests", "wall_ms"),
     # crash-safe checkpointing (doc/checkpointing.md): per-snapshot
     # commit accounting (phase split shows the training thread paid
     # only gather_ms when async), retention GC, the validated-resume

@@ -12,6 +12,17 @@ The serving subsystem (doc/serving.md). Pieces:
 - :mod:`~cxxnet_tpu.serve.server` — config-driven ``ServeSession`` and
   the closed-loop client drive behind ``task = serve`` and
   ``tools/serve_bench.py``
+
+The fleet layer (``task = serve_fleet``, doc/serving.md):
+
+- :mod:`~cxxnet_tpu.serve.router` — multi-model routing: N engines
+  behind one front end, atomic hot-swap flip
+- :mod:`~cxxnet_tpu.serve.quota` — per-tenant token-bucket quotas and
+  typed over-quota shedding
+- :mod:`~cxxnet_tpu.serve.swap` — checkpoint-driven zero-downtime
+  hot-swap (verified-snapshot watcher, shadow warmup, flip + drain)
+- :mod:`~cxxnet_tpu.serve.frontend` — the network front end: HTTP/JSON
+  + length-prefixed binary protocols over one shared request core
 """
 
 from .batcher import (DynamicBatcher, ServeBusyError, ServeClosedError,
@@ -19,11 +30,18 @@ from .batcher import (DynamicBatcher, ServeBusyError, ServeClosedError,
 from .bucketing import (bucket_ladder, mesh_align, pad_to_bucket,
                         parse_buckets, pick_bucket)
 from .engine import InferenceEngine, StagedBatch, build_engine
+from .frontend import BinaryClient, FleetConfig, FleetServer
+from .quota import QuotaManager, TenantQuotaError, TokenBucket
+from .router import ModelRouter, UnknownModelError
 from .server import ServeConfig, ServeSession, run_closed_loop
+from .swap import SnapshotWatcher, latest_verified
 
 __all__ = [
     "DynamicBatcher", "ServeBusyError", "ServeClosedError",
     "ServeTimeoutError", "bucket_ladder", "mesh_align", "pad_to_bucket",
     "parse_buckets", "pick_bucket", "InferenceEngine", "StagedBatch",
     "build_engine", "ServeConfig", "ServeSession", "run_closed_loop",
+    "BinaryClient", "FleetConfig", "FleetServer", "QuotaManager",
+    "TenantQuotaError", "TokenBucket", "ModelRouter",
+    "UnknownModelError", "SnapshotWatcher", "latest_verified",
 ]

@@ -1,0 +1,641 @@
+"""Fleet front end: network protocols over the serve subsystem.
+
+``FleetServer`` turns N :class:`~cxxnet_tpu.serve.server.ServeSession`
+engines into one deployable service (``task = serve_fleet``,
+doc/serving.md):
+
+- **two protocols, one core** — an HTTP/JSON endpoint for
+  debuggability (curl-able, self-describing errors) and a
+  length-prefixed binary protocol for raw float rows (no JSON
+  float-printing cost on the hot path). Both funnel into
+  :meth:`FleetServer.handle`, so routing, quotas, shedding and
+  telemetry behave identically.
+- **multi-model routing** — requests name a model id; the
+  :class:`~cxxnet_tpu.serve.router.ModelRouter` resolves it to the
+  live engine (each with its own bucket ladder and drain lifecycle).
+- **tenant quotas** — every request passes the
+  :class:`~cxxnet_tpu.serve.quota.QuotaManager` *before* touching the
+  shared dispatcher queue; an over-quota tenant is shed with a typed
+  429-style reply (``over_quota``, Retry-After) instead of queueing
+  into everyone's p99. Dispatcher backpressure
+  (:class:`~cxxnet_tpu.serve.batcher.ServeBusyError`) and deadlines
+  (``ServeTimeoutError``) map to ``busy`` (429) and ``timeout`` (504)
+  the same way.
+- **zero-downtime hot-swap** — a
+  :class:`~cxxnet_tpu.serve.swap.SnapshotWatcher` per model polls its
+  ``model_dir`` for newer *verified* snapshots, warms a shadow engine,
+  flips the router entry, drains the old engine. The front end retries
+  the one unclosable race (``ServeClosedError`` from a session that
+  was flipped away mid-request) through a fresh resolve, so a swap
+  never fails a request.
+
+Every request emits a schema-validated ``serve_http`` record; quota
+sheds additionally emit ``tenant_shed``; swaps emit ``hot_swap``.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .batcher import (ServeBusyError, ServeClosedError,
+                      ServeTimeoutError)
+from .quota import QuotaManager, TenantQuotaError
+from .router import ModelRouter, UnknownModelError
+from .server import ServeSession
+from .swap import SnapshotWatcher, counter_of, latest_verified
+
+# -- binary protocol ------------------------------------------------------
+#
+# Request:  MAGIC | u8 model_len | u8 tenant_len | u32 nrows |
+#           u32 elems_per_row | f32 timeout_ms | model utf8 |
+#           tenant utf8 | nrows*elems float32 LE rows
+# Reply:    MAGIC | u8 status | u32 nrows | u32 elems_per_row |
+#           payload: float32 LE rows (status 0) or
+#           u32 msg_len + utf8 message (any other status)
+
+BIN_MAGIC = b"CXN1"
+_REQ_HEADER = struct.Struct("<4sBBIIf")
+_REP_HEADER = struct.Struct("<4sBII")
+_MSG_LEN = struct.Struct("<I")
+
+# hard sanity caps on a single binary frame: a corrupt length prefix
+# must fail the frame, not allocate gigabytes
+MAX_FRAME_ROWS = 1 << 20
+MAX_FRAME_BYTES = 256 << 20
+
+STATUS_OK = 0
+STATUS_BUSY = 1
+STATUS_OVER_QUOTA = 2
+STATUS_TIMEOUT = 3
+STATUS_UNKNOWN_MODEL = 4
+STATUS_BAD_REQUEST = 5
+STATUS_CLOSED = 6
+STATUS_ERROR = 7
+
+STATUS_NAMES = {
+    STATUS_OK: "ok", STATUS_BUSY: "busy",
+    STATUS_OVER_QUOTA: "over_quota", STATUS_TIMEOUT: "timeout",
+    STATUS_UNKNOWN_MODEL: "unknown_model",
+    STATUS_BAD_REQUEST: "bad_request", STATUS_CLOSED: "closed",
+    STATUS_ERROR: "error",
+}
+STATUS_CODES = {v: k for k, v in STATUS_NAMES.items()}
+
+# HTTP status per outcome: both shedding outcomes are 429 (the typed
+# JSON body and Retry-After distinguish quota from backpressure),
+# deadline expiry is the gateway-timeout class
+HTTP_STATUS = {
+    "ok": 200, "busy": 429, "over_quota": 429, "timeout": 504,
+    "unknown_model": 404, "bad_request": 400, "closed": 503,
+    "error": 500,
+}
+
+
+def pack_request(model: str, tenant: str, rows: np.ndarray,
+                 timeout_ms: float = 0.0) -> bytes:
+    """Encode one binary-protocol request frame."""
+    rows = np.ascontiguousarray(rows, dtype="<f4")
+    if rows.ndim == 1:
+        rows = rows[None, :]
+    flat = rows.reshape(rows.shape[0], -1)
+    m, t = model.encode(), tenant.encode()
+    if len(m) > 255 or len(t) > 255:
+        raise ValueError("model/tenant ids are limited to 255 bytes")
+    return (_REQ_HEADER.pack(BIN_MAGIC, len(m), len(t), flat.shape[0],
+                             flat.shape[1], float(timeout_ms))
+            + m + t + flat.tobytes())
+
+
+def pack_reply(status: int, payload: np.ndarray = None,
+               message: str = "") -> bytes:
+    """Encode one binary-protocol reply frame."""
+    if status == STATUS_OK:
+        flat = np.ascontiguousarray(payload, dtype="<f4")
+        flat = flat.reshape(flat.shape[0], -1)
+        return (_REP_HEADER.pack(BIN_MAGIC, status, flat.shape[0],
+                                 flat.shape[1]) + flat.tobytes())
+    msg = message.encode()
+    return (_REP_HEADER.pack(BIN_MAGIC, status, 0, 0)
+            + _MSG_LEN.pack(len(msg)) + msg)
+
+
+def _read_exact(rfile, n: int) -> Optional[bytes]:
+    """Read exactly n bytes; None on clean EOF at a frame boundary."""
+    buf = b""
+    while len(buf) < n:
+        chunk = rfile.read(n - len(buf))
+        if not chunk:
+            return None if not buf else buf  # torn frame signals below
+        buf += chunk
+    return buf
+
+
+def read_reply(rfile) -> Tuple[str, Any]:
+    """Read one reply frame -> (status_name, rows | message)."""
+    hdr = _read_exact(rfile, _REP_HEADER.size)
+    if hdr is None or len(hdr) < _REP_HEADER.size:
+        raise IOError("connection closed mid-reply")
+    magic, status, nrows, elems = _REP_HEADER.unpack(hdr)
+    if magic != BIN_MAGIC:
+        raise IOError("bad reply magic %r" % magic)
+    name = STATUS_NAMES.get(status, "error")
+    if status == STATUS_OK:
+        payload = _read_exact(rfile, nrows * elems * 4)
+        if payload is None or len(payload) < nrows * elems * 4:
+            raise IOError("connection closed mid-payload")
+        return name, np.frombuffer(payload, "<f4").reshape(nrows,
+                                                           elems)
+    raw = _read_exact(rfile, _MSG_LEN.size)
+    if raw is None or len(raw) < _MSG_LEN.size:
+        raise IOError("connection closed mid-reply")
+    mlen = _MSG_LEN.unpack(raw)[0]
+    msg = _read_exact(rfile, mlen) if mlen else b""
+    return name, (msg or b"").decode(errors="replace")
+
+
+class BinaryClient:
+    """Minimal persistent-connection client for the binary protocol
+    (the closed-loop drive in tests and ``tools/serve_bench.py``)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        self._rfile = self.sock.makefile("rb")
+
+    def predict(self, rows: np.ndarray, model: str = "",
+                tenant: str = "",
+                timeout_ms: float = 0.0) -> Tuple[str, Any]:
+        self.sock.sendall(pack_request(model, tenant, rows,
+                                       timeout_ms))
+        return read_reply(self._rfile)
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        finally:
+            self.sock.close()
+
+
+# -- fleet configuration --------------------------------------------------
+
+
+class FleetConfig:
+    """Parsed ``serve_fleet`` keys (doc/serving.md):
+
+    - ``serve_models`` — list of ``id=source[|buckets]`` entries; the
+      source is a model_dir to watch (newest verified snapshot) or an
+      explicit snapshot file. Entries separate on ``,``, or on ``;``
+      when any entry carries a ``|buckets`` override (bucket ladders
+      are comma lists themselves: ``main=./m1;alt=./m2|1,8``).
+      Default: one model ``default`` over ``model_in`` (if set) or
+      ``model_dir``.
+    - ``serve_http_port`` / ``serve_binary_port`` — listen ports
+      (0 = ephemeral, -1 = protocol disabled).
+    - ``serve_host`` — bind address (default 127.0.0.1; set 0.0.0.0
+      to serve off-host).
+    - ``serve_swap_poll_s`` — hot-swap watcher period (0 = no
+      watchers).
+    - ``serve_fleet_duration_s`` — CLI run time (0 = until
+      SIGTERM/SIGINT).
+    """
+
+    def __init__(self, cfg: Sequence):
+        self.models: List[Tuple[str, str, str]] = []
+        self.http_port = 0
+        self.binary_port = 0
+        self.host = "127.0.0.1"
+        self.swap_poll_s = 2.0
+        self.duration_s = 0.0
+        model_dir, model_in = "./models", ""
+        for name, val in cfg:
+            if name == "serve_models":
+                self.models = self._parse_models(val)
+            if name == "serve_http_port":
+                self.http_port = int(val)
+            if name == "serve_binary_port":
+                self.binary_port = int(val)
+            if name == "serve_host":
+                self.host = val
+            if name == "serve_swap_poll_s":
+                self.swap_poll_s = float(val)
+            if name == "serve_fleet_duration_s":
+                self.duration_s = float(val)
+            if name == "model_dir":
+                model_dir = val
+            if name == "model_in":
+                model_in = val
+        if not self.models:
+            self.models = [("default", model_in or model_dir, "")]
+        if self.http_port < 0 and self.binary_port < 0:
+            raise ValueError(
+                "serve_fleet with both protocols disabled serves "
+                "nothing — enable serve_http_port or "
+                "serve_binary_port")
+
+    @staticmethod
+    def _parse_models(spec: str) -> List[Tuple[str, str, str]]:
+        # entries separate on ';' when any entry carries a bucket
+        # override (bucket ladders are comma lists themselves:
+        # ``main=./m1;alt=./m2|1,8``); a plain spec may use ','
+        sep = ";" if (";" in spec or "|" in spec) else ","
+        out = []
+        for entry in spec.split(sep):
+            entry = entry.strip()
+            if not entry:
+                continue
+            mid, eq, src = entry.partition("=")
+            if not eq or not mid or not src:
+                raise ValueError(
+                    "serve_models entry %r must be id=source[|buckets]"
+                    % entry)
+            src, _, buckets = src.partition("|")
+            out.append((mid.strip(), src.strip(), buckets.strip()))
+        ids = [m for m, _, _ in out]
+        if len(set(ids)) != len(ids):
+            raise ValueError("duplicate model id in serve_models: %r"
+                             % spec)
+        return out
+
+
+# -- the fleet server -----------------------------------------------------
+
+
+class FleetServer:
+    """N routed engines + quotas + hot-swap behind two protocol
+    listeners. Build from the same ordered config-pair stream as the
+    rest of the system; ``start()`` binds the listeners (ephemeral
+    ports resolve to ``http_port``/``binary_port`` attributes),
+    ``close()`` stops watchers and listeners and drains every
+    engine."""
+
+    def __init__(self, cfg: Sequence, monitor=None):
+        self.cfg = list(cfg)
+        self.fleet_cfg = FleetConfig(self.cfg)
+        self.quota = QuotaManager(self.cfg)
+        self.router = ModelRouter()
+        self._mon = monitor
+        self._closing = False
+        self._closed = False
+        self._stats = threading.Lock()
+        self.counters: Dict[str, int] = {
+            name: 0 for name in STATUS_NAMES.values()}
+        self.counters["requests"] = 0
+        self._watchers: List[SnapshotWatcher] = []
+        self._http_server = None
+        self._binary_server = None
+        self._threads: List[threading.Thread] = []
+        self.http_port = -1
+        self.binary_port = -1
+        for model_id, src, buckets in self.fleet_cfg.models:
+            counter, path, watch_dir = self._resolve_source(src)
+            session = self.build_session(path, buckets)
+            self.router.register(model_id, session, counter, path)
+            if watch_dir and self.fleet_cfg.swap_poll_s > 0:
+                self._watchers.append(SnapshotWatcher(
+                    self.router, model_id, watch_dir,
+                    builder=lambda p, b=buckets:
+                        self.build_session(p, b),
+                    poll_s=self.fleet_cfg.swap_poll_s,
+                    monitor=monitor))
+
+    @staticmethod
+    def _resolve_source(src: str) -> Tuple[int, str, str]:
+        """A model source is a snapshot file (PINNED: served as-is, no
+        watcher — naming an exact snapshot is a deliberate version
+        pin) or a model_dir (serve the newest verified snapshot and
+        hot-swap as newer ones commit). Returns (counter,
+        snapshot_path, dir_to_watch) — watch dir "" means pinned."""
+        from ..utils.stream import stream_exists
+        if src.endswith(".npz") and stream_exists(src):
+            return counter_of(src), src, ""
+        counter, path = latest_verified(src)
+        if path is None:
+            raise FileNotFoundError(
+                "model source %r holds no verified snapshot" % src)
+        return counter, path, src
+
+    def build_session(self, path: str, buckets: str = "") -> \
+            ServeSession:
+        """Session factory shared by boot and the hot-swap shadow
+        build: full warmup inside, per-model bucket override appended
+        last so it wins over a global ``serve_buckets``."""
+        cfg = self.cfg
+        if buckets:
+            cfg = cfg + [("serve_buckets", buckets)]
+        return ServeSession(cfg, model_path=path, monitor=self._mon)
+
+    # -- the one request path both protocols share -----------------------
+
+    def handle(self, model_id: str, tenant: str, rows,
+               protocol: str = "http",
+               timeout_ms: Optional[float] = None
+               ) -> Tuple[str, Any, Dict[str, Any]]:
+        """Route one request: quota -> router -> dispatcher. Returns
+        ``(status_name, result_rows | message, extra)`` — never
+        raises, so a protocol handler cannot leak a stack trace to the
+        wire."""
+        t0 = time.monotonic()
+        nrows = 0
+        resolved = model_id
+        try:
+            entry = self.router.resolve(model_id)
+            resolved = entry.model_id
+            arr = self._shape_rows(entry, rows)
+            nrows = arr.shape[0]
+            try:
+                self.quota.admit(tenant, nrows)
+            except TenantQuotaError as e:
+                self._emit("tenant_shed", tenant=tenant,
+                           model=resolved, rows=nrows, rate=e.rate,
+                           burst=e.burst,
+                           retry_after_s=round(e.retry_after_s, 3))
+                raise
+            out = self._predict_with_retry(resolved, arr, timeout_ms)
+            status, result, extra = "ok", out, {}
+        except TenantQuotaError as e:
+            status, result = "over_quota", str(e)
+            extra = {"retry_after_s": e.retry_after_s}
+        except ServeBusyError as e:
+            status, result, extra = "busy", str(e), {}
+        except ServeTimeoutError as e:
+            status, result, extra = "timeout", str(e), {}
+        except ServeClosedError as e:
+            status, result, extra = "closed", str(e), {}
+        except UnknownModelError as e:
+            status, result, extra = "unknown_model", str(e.args[0]), {}
+        except (ValueError, TypeError) as e:
+            status, result, extra = "bad_request", str(e), {}
+        except Exception as e:       # an engine bug must answer, not hang
+            status, result, extra = "error", str(e), {}
+        self._record(protocol, status, resolved, tenant, nrows, t0)
+        return status, result, extra
+
+    def _shape_rows(self, entry, rows) -> np.ndarray:
+        """Coerce client rows (flat or natural layout) to the served
+        instance shape; mismatches bounce as bad_request."""
+        arr = np.asarray(rows, dtype=np.float32)
+        inst = entry.session.engine._inst_shape()
+        elems = int(np.prod(inst))
+        if arr.ndim == 1 and arr.size == elems:
+            arr = arr.reshape((1,) + inst)
+        elif arr.ndim == 2 and arr.shape[1] == elems \
+                and arr.shape[1:] != inst:
+            arr = arr.reshape((arr.shape[0],) + inst)
+        if arr.ndim != len(inst) + 1 or arr.shape[1:] != inst:
+            raise ValueError(
+                "rows of shape %r do not match the served instance "
+                "shape %r (%d values per row)"
+                % (tuple(arr.shape), inst, elems))
+        return arr
+
+    def _predict_with_retry(self, model_id: str, arr: np.ndarray,
+                            timeout_ms: Optional[float]) -> np.ndarray:
+        """Dispatch through the CURRENT session for ``model_id``; a
+        ``ServeClosedError`` during a hot-swap window (the request
+        resolved the old session right as it began draining) retries
+        through a fresh resolve — the new engine is already routed, so
+        in-flight requests never fail during a swap."""
+        for _ in range(8):
+            entry = self.router.resolve(model_id)
+            try:
+                return entry.session.predict(arr, timeout_ms)
+            except ServeClosedError:
+                if self._closing:
+                    raise
+                time.sleep(0.001)   # let the flip commit, then re-resolve
+        raise ServeClosedError(
+            "model %r kept draining across retries" % model_id)
+
+    # -- telemetry / accounting -------------------------------------------
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self._mon is None or not self._mon.enabled:
+            return
+        try:
+            self._mon.emit(kind, **fields)
+        except Exception:
+            pass            # telemetry failure must not fail requests
+
+    def _record(self, protocol: str, status: str, model: str,
+                tenant: str, rows: int, t0: float) -> None:
+        with self._stats:
+            self.counters["requests"] += 1
+            self.counters[status] = self.counters.get(status, 0) + 1
+        self._emit("serve_http", protocol=protocol, status=status,
+                   model=model, tenant=tenant, rows=rows,
+                   latency_ms=(time.monotonic() - t0) * 1e3)
+
+    def describe(self) -> List[Dict[str, Any]]:
+        """Model table with the client-facing dispatch contract."""
+        out = []
+        for e in (self.router.resolve(m) for m in self.router.ids()):
+            inst = e.session.engine._inst_shape()
+            out.append({
+                "model": e.model_id, "counter": e.counter,
+                "path": e.path, "generation": e.generation,
+                "max_batch": e.session.engine.max_batch,
+                "row_elems": int(np.prod(inst)),
+                "instance_shape": list(inst),
+                "buckets": list(e.session.engine.buckets),
+            })
+        return out
+
+    # -- listeners --------------------------------------------------------
+
+    def start(self) -> None:
+        c = self.fleet_cfg
+        if c.http_port >= 0:
+            self._http_server = _FleetHTTPServer(
+                (c.host, c.http_port), _HttpHandler, self)
+            self.http_port = self._http_server.server_address[1]
+            t = threading.Thread(
+                target=self._http_server.serve_forever,
+                name="serve-http", daemon=True)
+            t.start()
+            self._threads.append(t)
+        if c.binary_port >= 0:
+            self._binary_server = _FleetBinaryServer(
+                (c.host, c.binary_port), _BinaryHandler, self)
+            self.binary_port = \
+                self._binary_server.server_address[1]
+            t = threading.Thread(
+                target=self._binary_server.serve_forever,
+                name="serve-binary", daemon=True)
+            t.start()
+            self._threads.append(t)
+        for w in self._watchers:
+            w.start()
+
+    def close(self, drain: bool = True) -> Dict[str, Any]:
+        """Stop watchers, stop intake (listeners), drain every
+        engine. Idempotent; returns the fleet summary."""
+        if self._closed:
+            return self._summary({})
+        self._closed = True
+        self._closing = True
+        for w in self._watchers:
+            w.close()
+        for srv in (self._http_server, self._binary_server):
+            if srv is not None:
+                srv.shutdown()
+                srv.server_close()
+        for t in self._threads:
+            t.join(timeout=30)
+        summaries = self.router.close_all(drain=drain)
+        return self._summary(summaries)
+
+    def _summary(self, per_model: Dict[str, Dict]) -> Dict[str, Any]:
+        with self._stats:
+            c = dict(self.counters)
+        return {"requests": c, "models": per_model,
+                "quota": self.quota.snapshot(),
+                "swaps": sum(w.swaps for w in self._watchers)}
+
+
+# -- HTTP protocol --------------------------------------------------------
+
+
+class _FleetHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, handler, fleet: FleetServer):
+        self.fleet = fleet
+        super().__init__(addr, handler)
+
+
+class _HttpHandler(BaseHTTPRequestHandler):
+    server_version = "cxxnet-serve"
+    protocol_version = "HTTP/1.1"
+
+    def _send_json(self, code: int, obj: Dict[str, Any],
+                   headers: Dict[str, str] = ()) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in dict(headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        fleet = self.server.fleet
+        if self.path == "/healthz":
+            self._send_json(200, {"ok": True,
+                                  "models": fleet.router.ids()})
+        elif self.path == "/v1/models":
+            self._send_json(200, {"models": fleet.describe()})
+        else:
+            self._send_json(404, {"error": "not_found",
+                                  "message": "unknown path %r"
+                                  % self.path})
+
+    def do_POST(self):
+        fleet = self.server.fleet
+        if self.path != "/v1/predict":
+            self._send_json(404, {"error": "not_found",
+                                  "message": "POST /v1/predict"})
+            return
+        t0 = time.monotonic()
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            model = str(req.get("model", ""))
+            tenant = str(req.get("tenant", ""))
+            timeout_ms = req.get("timeout_ms")
+            rows = req["rows"]
+        except (ValueError, KeyError, TypeError) as e:
+            # malformed body: never reached the shared core, so the
+            # request is recorded here for the stream's completeness
+            fleet._record("http", "bad_request", "", "", 0, t0)
+            self._send_json(400, {"error": "bad_request",
+                                  "message": "body must be JSON with "
+                                  "'rows': %s" % e})
+            return
+        status, result, extra = fleet.handle(
+            model, tenant, rows, protocol="http",
+            timeout_ms=timeout_ms)
+        code = HTTP_STATUS[status]
+        if status == "ok":
+            flat = np.asarray(result)
+            self._send_json(code, {
+                "model": model or fleet.router.default_id,
+                "rows": int(flat.shape[0]),
+                "result": flat.reshape(flat.shape[0], -1).tolist()})
+            return
+        headers = {}
+        if status in ("busy", "over_quota"):
+            headers["Retry-After"] = "%d" % max(
+                1, int(extra.get("retry_after_s", 1) + 0.999))
+        self._send_json(code, dict(
+            {"error": status, "message": result}, **extra),
+            headers=headers)
+
+    def log_message(self, fmt, *args):   # stdout parity: no access log
+        pass
+
+
+# -- binary protocol ------------------------------------------------------
+
+
+class _FleetBinaryServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, handler, fleet: FleetServer):
+        self.fleet = fleet
+        super().__init__(addr, handler)
+
+
+class _BinaryHandler(socketserver.StreamRequestHandler):
+    """Persistent connection: one request frame in, one reply frame
+    out, until the client closes. A malformed frame answers
+    bad_request and drops the connection (a desynced length-prefixed
+    stream cannot be re-synchronized)."""
+
+    def handle(self):
+        fleet = self.server.fleet
+        while True:
+            hdr = _read_exact(self.rfile, _REQ_HEADER.size)
+            if hdr is None:
+                return                        # clean EOF between frames
+            if len(hdr) < _REQ_HEADER.size:
+                return                        # torn header: drop
+            magic, mlen, tlen, nrows, elems, timeout_ms = \
+                _REQ_HEADER.unpack(hdr)
+            if (magic != BIN_MAGIC or nrows > MAX_FRAME_ROWS
+                    or nrows * max(1, elems) * 4 > MAX_FRAME_BYTES):
+                self.wfile.write(pack_reply(
+                    STATUS_BAD_REQUEST,
+                    message="bad frame header (magic %r, %d x %d)"
+                    % (magic, nrows, elems)))
+                return
+            body = _read_exact(self.rfile,
+                               mlen + tlen + nrows * elems * 4)
+            if body is None or len(body) < mlen + tlen + \
+                    nrows * elems * 4:
+                return                        # torn body: drop
+            model = body[:mlen].decode(errors="replace")
+            tenant = body[mlen:mlen + tlen].decode(errors="replace")
+            rows = np.frombuffer(body[mlen + tlen:],
+                                 "<f4").reshape(nrows, elems) \
+                if nrows else np.zeros((0, max(1, elems)), np.float32)
+            status, result, _ = fleet.handle(
+                model, tenant, rows, protocol="binary",
+                timeout_ms=timeout_ms if timeout_ms > 0 else None)
+            if status == "ok":
+                self.wfile.write(pack_reply(STATUS_OK,
+                                            payload=result))
+            else:
+                self.wfile.write(pack_reply(STATUS_CODES[status],
+                                            message=str(result)))

@@ -1,0 +1,193 @@
+"""Checkpoint-driven zero-downtime hot-swap.
+
+A :class:`SnapshotWatcher` polls a model's ``model_dir`` for a newer
+*verified* snapshot (the crash-safe checkpoint subsystem's contract:
+digest + structure checked by ``nnet.checkpoint.verify_snapshot``
+before a single byte is trusted), builds and bucket-warms a **shadow**
+engine off the request path, then atomically flips the router entry
+and drains the retired engine. The swap sequence:
+
+1. **scan** — committed candidates newest-first. Deliberately *not*
+   :func:`~cxxnet_tpu.nnet.checkpoint.find_latest_valid`: that scan
+   owns resume semantics (it sweeps stale ``.tmp`` files and
+   quarantines corrupt candidates) and assumes no live writer — but a
+   watched ``model_dir`` usually HAS a live writer (the training run
+   producing the snapshots being served). :func:`latest_verified` is
+   the read-only equivalent: ``scan_snapshots`` + ``verify_snapshot``,
+   skip-don't-touch on anything invalid or in-flight.
+2. **shadow build** — a full :class:`~cxxnet_tpu.serve.server.
+   ServeSession` (own trainer, own mesh, own bucket ladder) warms
+   every (bucket, mask-variant) executable before the flip, so the
+   first request on the new engine pays zero compile cost; the
+   engine's ``compile_events``/``aot_hits`` counters account for it
+   the same way the steady-state contract is counted.
+3. **flip** — ``router.swap`` replaces the entry atomically; new
+   requests route to the shadow engine from that instant.
+4. **drain** — the retired session ``close(drain=True)``s: requests
+   already queued on it complete, then its workers join. The front
+   end retries the one unclosable race (resolved-old, submitted-after-
+   drain-began) through a fresh resolve, so in-flight requests never
+   fail during a swap.
+
+Every swap emits a schema-validated ``hot_swap`` record; a failed
+shadow build warns and leaves the old engine serving (failing to
+*upgrade* must never take down what currently works).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..nnet.checkpoint import (MODEL_RE, scan_snapshots, snapshot_uri,
+                               verify_snapshot)
+from .router import ModelRouter
+
+
+def latest_verified(model_dir: str) -> Tuple[Optional[int],
+                                             Optional[str]]:
+    """Newest snapshot in ``model_dir`` that passes
+    ``verify_snapshot``, as (counter, uri); (None, None) when none
+    does. Read-only — safe against a model_dir a live training run is
+    committing into (see module docstring)."""
+    try:
+        candidates = scan_snapshots(model_dir)
+    except (IOError, OSError):
+        return None, None
+    for counter, name in candidates:
+        uri = snapshot_uri(model_dir, name)
+        if verify_snapshot(uri)["ok"]:
+            return counter, uri
+    return None, None
+
+
+def counter_of(path: str) -> int:
+    """Snapshot counter from a ``NNNN.model.npz`` basename (0 when the
+    name does not follow the convention — e.g. an explicit model_in
+    file — so any watched counter >= 1 upgrades it)."""
+    m = MODEL_RE.match(os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
+class SnapshotWatcher:
+    """Poll one model's directory and hot-swap on a newer verified
+    snapshot.
+
+    ``builder(path)`` must return a warmed-up ``ServeSession`` for the
+    snapshot at ``path`` (the front end passes its session factory).
+    ``check_once()`` is the synchronous core — the poll thread calls
+    it on a timer; tests and the CLI can call it directly.
+    """
+
+    def __init__(self, router: ModelRouter, model_id: str,
+                 model_dir: str,
+                 builder: Callable[[str], Any],
+                 poll_s: float = 2.0, monitor=None):
+        self.router = router
+        self.model_id = model_id
+        self.model_dir = model_dir
+        self.builder = builder
+        self.poll_s = max(0.05, float(poll_s))
+        self._mon = monitor
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.swaps = 0
+        self.failed_builds = 0
+
+    # -- the swap core ----------------------------------------------------
+
+    def check_once(self) -> Optional[Dict[str, Any]]:
+        """One poll: swap if a newer verified snapshot exists. Returns
+        the ``hot_swap`` record fields on a swap, None otherwise.
+        Never raises — a failed shadow build warns and leaves the
+        current engine serving."""
+        counter, path = latest_verified(self.model_dir)
+        if counter is None:
+            return None
+        try:
+            current = self.router.resolve(self.model_id)
+        except KeyError:
+            return None
+        if counter <= current.counter:
+            return None
+        t0 = time.monotonic()
+        try:
+            # shadow build + bucket warmup, off the request path: the
+            # router still serves the old engine while this compiles
+            session = self.builder(path)
+        except Exception as e:
+            self.failed_builds += 1
+            self._warn("hot_swap_build_failed:%s" % path,
+                       "hot-swap of model %r to %s failed to build "
+                       "(%s); keeping the current engine"
+                       % (self.model_id, path, e))
+            return None
+        try:
+            old = self.router.swap(self.model_id, session, counter,
+                                   path)
+        except Exception as e:
+            # router refused (closed mid-build, entry gone): the
+            # shadow engine must not leak its dispatcher threads
+            session.close(drain=False)
+            self._warn("hot_swap_flip_failed:%s" % path,
+                       "hot-swap of model %r to %s could not flip "
+                       "(%s); shadow engine discarded"
+                       % (self.model_id, path, e))
+            return None
+        # drain AFTER the flip: new traffic is already landing on the
+        # shadow engine, old traffic finishes on the retiring one
+        old_summary = old.session.close(drain=True)
+        self.swaps += 1
+        rec = {
+            "model": self.model_id,
+            "old_counter": old.counter,
+            "new_counter": counter,
+            "path": path,
+            "warmup_programs": int(
+                getattr(session, "warmup_programs", 0)),
+            "old_requests": int(old_summary.get("requests", 0)),
+            "old_compile_events": int(
+                old_summary.get("compile_events", 0)),
+            "wall_ms": (time.monotonic() - t0) * 1e3,
+        }
+        if self._mon is not None and self._mon.enabled:
+            try:
+                self._mon.emit("hot_swap", **rec)
+            except Exception:
+                pass                     # telemetry must not kill swaps
+        return rec
+
+    def _warn(self, code: str, message: str) -> None:
+        if self._mon is not None:
+            self._mon.warn_once(code, message)
+        else:
+            from ..monitor import warn_once
+            warn_once(code, message)
+
+    # -- poll thread ------------------------------------------------------
+
+    def start(self) -> None:
+        assert self._thread is None, "watcher already started"
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-watch-%s" % self.model_id,
+            daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.check_once()
+            except Exception as e:
+                # the watcher must outlive any single bad poll (e.g. a
+                # transient remote-list error)
+                self._warn("hot_swap_poll_failed",
+                           "hot-swap poll for model %r failed: %s"
+                           % (self.model_id, e))
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None

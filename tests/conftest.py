@@ -29,6 +29,15 @@ import pytest
 
 assert jax.default_backend() == "cpu"
 
+# the reference checkout is not mounted in every container; suites
+# that parse its actual example configs mark themselves with this and
+# skip (not fail) without it
+REFERENCE_DIR = "/root/reference"
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_DIR),
+    reason="reference mount %s is absent in this container"
+    % REFERENCE_DIR)
+
 
 @pytest.fixture
 def rng():

@@ -8,7 +8,7 @@ import pytest
 from cxxnet_tpu.utils.config import (ConfigError, parse_config,
                                      parse_cli_overrides, split_sections)
 
-REF = "/root/reference"
+from tests.conftest import REFERENCE_DIR as REF, needs_reference
 
 
 def test_basic_pairs():
@@ -40,6 +40,7 @@ def test_cli_overrides():
         [("max_round", "3"), ("dev", "tpu")]
 
 
+@needs_reference
 def test_split_sections_mnist():
     with open(os.path.join(REF, "example/MNIST/MNIST.conf")) as f:
         pairs = parse_config(f.read())
@@ -56,6 +57,7 @@ def test_split_sections_mnist():
     assert "path_img" not in gk
 
 
+@needs_reference
 def test_split_sections_imagenet():
     with open(os.path.join(REF, "example/ImageNet/Inception-BN.conf")) as f:
         pairs = parse_config(f.read())

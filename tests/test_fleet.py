@@ -1,0 +1,563 @@
+"""Fleet serving: quota policy, multi-model routing, binary protocol
+framing, read-only verified-snapshot scanning, and the tier-1 CPU smoke
+— both protocols through a live front end with threaded clients, one
+hot-swap mid-traffic (zero failed requests, zero post-warmup compiles
+on either engine), an over-quota tenant shed with the typed busy reply
+while in-quota tenants all succeed, clean shutdown, schema-valid
+stream."""
+
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.monitor import MemorySink, Monitor
+from cxxnet_tpu.monitor.schema import validate_records
+from cxxnet_tpu.serve import (FleetConfig, FleetServer, ModelRouter,
+                              QuotaManager, TenantQuotaError,
+                              TokenBucket, UnknownModelError,
+                              latest_verified)
+from cxxnet_tpu.serve.frontend import (BIN_MAGIC, STATUS_OK,
+                                       BinaryClient, pack_reply,
+                                       pack_request, read_reply)
+from cxxnet_tpu.serve.swap import counter_of
+
+
+# -- token buckets / quota policy (pure, no jax) -------------------------
+
+
+def test_token_bucket_admits_burst_then_refills():
+    b = TokenBucket(rate=1000.0, burst=4.0)
+    ok, _ = b.try_take(4)
+    assert ok                              # full burst available
+    ok, retry = b.try_take(4)
+    assert not ok and retry > 0            # drained
+    time.sleep(0.01)                       # 1000/s refills ~10 tokens
+    ok, _ = b.try_take(4)
+    assert ok
+    with pytest.raises(ValueError):
+        TokenBucket(0.0, 1.0)
+
+
+def test_token_bucket_oversized_request_caps_retry_after():
+    b = TokenBucket(rate=10.0, burst=2.0)
+    ok, retry = b.try_take(100)            # > burst: can never admit
+    assert not ok
+    # retry_after is capped at a full-burst wait, not 10 seconds
+    assert retry <= 2.0 / 10.0 + 1e-6
+
+
+def test_quota_manager_policies_and_isolation():
+    q = QuotaManager([("serve_quota", "free:100:2,vip:0"),
+                      ("serve_quota_default", "1000:3")])
+    # explicit tenant: its own bucket
+    q.admit("free", 2)
+    with pytest.raises(TenantQuotaError) as ei:
+        q.admit("free", 2)
+    assert ei.value.tenant == "free" and ei.value.rows == 2
+    assert ei.value.retry_after_s > 0
+    # rate 0 = exempt tenant
+    for _ in range(50):
+        q.admit("vip", 10)
+    # default policy: PER-TENANT buckets (a's burst must not drain b's)
+    q.admit("a", 3)
+    q.admit("b", 3)
+    with pytest.raises(TenantQuotaError):
+        q.admit("a", 3)
+    snap = q.snapshot()
+    assert snap["shed"] == 2 and snap["shed_by_tenant"]["free"] == 1
+    assert snap["admitted"] == 53     # free 1 + vip 50 + a 1 + b 1
+
+
+def test_quota_manager_default_is_unlimited():
+    q = QuotaManager([])
+    for _ in range(100):
+        q.admit("anyone", 1000)
+    assert q.snapshot()["shed"] == 0
+
+
+def test_quota_bad_specs_raise():
+    with pytest.raises(ValueError):
+        QuotaManager([("serve_quota", "free")])        # no rate
+    with pytest.raises(ValueError):
+        QuotaManager([("serve_quota", "free:-1")])     # negative
+    # a non-positive burst must fail at config parse, not as a
+    # per-request 400 blaming the tenant's first client
+    with pytest.raises(ValueError):
+        QuotaManager([("serve_quota", "free:10:0")])
+    with pytest.raises(ValueError):
+        QuotaManager([("serve_quota_default", "10:-5")])
+
+
+# -- the typed shed reply is a busy reply --------------------------------
+
+
+def test_tenant_quota_error_is_a_serve_busy_error():
+    """Library callers that already catch ServeBusyError (closed-loop
+    clients, run_closed_loop) must see quota sheds as load shedding."""
+    from cxxnet_tpu.serve import ServeBusyError
+    e = TenantQuotaError("t", 4, 10.0, 20.0, 0.4)
+    assert isinstance(e, ServeBusyError)
+    assert e.tenant == "t" and e.rate == 10.0 and e.burst == 20.0
+
+
+# -- router (pure) -------------------------------------------------------
+
+
+class _FakeSession:
+    def __init__(self, name):
+        self.name = name
+        self.closed = None
+
+    def close(self, drain=True):
+        self.closed = drain
+        return {"requests": 7, "compile_events": 0}
+
+
+def test_router_register_resolve_swap_close():
+    r = ModelRouter()
+    a, b = _FakeSession("a"), _FakeSession("b")
+    r.register("main", a, counter=1, path="p1")
+    with pytest.raises(ValueError):
+        r.register("main", a)              # duplicate id
+    assert r.default_id == "main"
+    assert r.resolve("").session is a      # "" routes to the default
+    assert r.resolve("main").session is a
+    with pytest.raises(UnknownModelError):
+        r.resolve("nope")
+    old = r.swap("main", b, counter=2, path="p2")
+    assert old.session is a and old.counter == 1
+    assert r.resolve("main").session is b
+    assert r.resolve("main").generation == 1
+    with pytest.raises(UnknownModelError):
+        r.swap("ghost", b, 1, "")
+    out = r.close_all()
+    assert out == {"main": {"requests": 7, "compile_events": 0}}
+    assert b.closed is True
+    assert r.close_all() == {}             # idempotent
+
+
+# -- fleet config grammar ------------------------------------------------
+
+
+def test_fleet_config_parses_models_and_ports():
+    c = FleetConfig([
+        ("serve_models", "main=./m1;alt=s3://bucket/m2|1,8"),
+        ("serve_http_port", "0"), ("serve_binary_port", "-1"),
+        ("serve_swap_poll_s", "0.5"),
+        ("serve_fleet_duration_s", "2")])
+    assert c.models == [("main", "./m1", ""),
+                        ("alt", "s3://bucket/m2", "1,8")]
+    assert c.http_port == 0 and c.binary_port == -1
+    assert c.swap_poll_s == 0.5 and c.duration_s == 2.0
+
+
+def test_fleet_config_default_model_and_errors():
+    c = FleetConfig([("model_dir", "./models")])
+    assert c.models == [("default", "./models", "")]
+    c = FleetConfig([("model_in", "snap.model.npz")])
+    assert c.models == [("default", "snap.model.npz", "")]
+    with pytest.raises(ValueError):
+        FleetConfig([("serve_models", "a=./x,a=./y")])  # dup id
+    with pytest.raises(ValueError):
+        FleetConfig([("serve_models", "nodir")])
+    with pytest.raises(ValueError):
+        FleetConfig([("serve_http_port", "-1"),
+                     ("serve_binary_port", "-1")])
+
+
+# -- binary protocol framing (pure) --------------------------------------
+
+
+def test_binary_frame_roundtrip():
+    rows = np.arange(12, dtype=np.float32).reshape(3, 4)
+    frame = pack_request("m", "tenant", rows, timeout_ms=5.0)
+    assert frame[:4] == BIN_MAGIC
+    out = pack_reply(STATUS_OK, payload=rows * 2)
+    status, got = read_reply(io.BytesIO(out))
+    assert status == "ok"
+    np.testing.assert_array_equal(got, rows * 2)
+    # error replies carry the message, not a payload
+    err = pack_reply(4, message="unknown model 'x'")
+    status, msg = read_reply(io.BytesIO(err))
+    assert status == "unknown_model" and "unknown model" in msg
+    with pytest.raises(IOError):
+        read_reply(io.BytesIO(b"XXXX" + out[4:]))      # bad magic
+    with pytest.raises(ValueError):
+        pack_request("m" * 300, "t", rows)             # id too long
+
+
+# -- read-only verified-snapshot scan ------------------------------------
+
+
+def _save_mlp_snapshot(path, seed=0):
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.parallel import make_mesh
+    from cxxnet_tpu.utils.config import parse_config
+    t = NetTrainer(parse_config(FLEET_MLP_CONF) + [("seed", str(seed))],
+                   mesh=make_mesh(1, 1))
+    t.init_model()
+    t.save_model(str(path))
+    return t
+
+
+FLEET_MLP_CONF = """
+netconfig=start
+layer[+1:h] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.05
+layer[+1] = relu
+layer[h->o] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,64
+batch_size = 16
+eta = 0.1
+"""
+
+
+def test_latest_verified_skips_corrupt_and_never_deletes(tmp_path):
+    """The hot-swap watcher polls a model_dir a LIVE training run may
+    be committing into: the scan must pick the newest snapshot that
+    verifies, skip corrupt ones without quarantining them, and never
+    touch an in-flight .tmp (the find_latest_valid sweep would)."""
+    d = tmp_path / "models"
+    d.mkdir()
+    assert latest_verified(str(d)) == (None, None)
+    _save_mlp_snapshot(d / "0001.model.npz")
+    (d / "0002.model.npz").write_bytes(b"torn garbage")   # corrupt
+    (d / "0003.model.npz.tmp").write_bytes(b"in-flight")  # live commit
+    counter, path = latest_verified(str(d))
+    assert counter == 1 and path.endswith("0001.model.npz")
+    # read-only: the corrupt candidate was not quarantined, the tmp
+    # sibling was not swept
+    assert (d / "0002.model.npz").exists()
+    assert (d / "0003.model.npz.tmp").exists()
+    assert not (d / "0002.model.npz.quarantined").exists()
+
+
+def test_counter_of():
+    assert counter_of("/x/0042.model.npz") == 42
+    assert counter_of("/x/custom.npz") == 0
+
+
+def test_explicit_snapshot_file_source_is_pinned(tmp_path):
+    """Naming an exact snapshot file in serve_models is a version pin:
+    no watcher is created for it, so newer snapshots committing into
+    the same directory never swap it away (a dir source would)."""
+    d = tmp_path / "models"
+    d.mkdir()
+    _save_mlp_snapshot(d / "0001.model.npz")
+    from cxxnet_tpu.utils.config import parse_config
+    cfg = parse_config(FLEET_MLP_CONF) + [
+        ("serve_models", "pinned=%s" % (d / "0001.model.npz")),
+        ("serve_http_port", "-1"), ("serve_binary_port", "0"),
+        ("serve_swap_poll_s", "0.05")]
+    server = FleetServer(cfg)
+    try:
+        assert server._watchers == []
+        assert server.router.resolve("pinned").counter == 1
+    finally:
+        server.close()
+
+
+def test_router_refuses_swap_after_close():
+    """A watcher finishing a shadow build after close_all must not
+    install an engine nothing will ever drain."""
+    r = ModelRouter()
+    a = _FakeSession("a")
+    r.register("main", a, counter=1, path="p1")
+    r.close_all()
+    with pytest.raises(RuntimeError, match="closed"):
+        r.swap("main", _FakeSession("b"), 2, "p2")
+
+
+# -- the fleet CPU smoke: both protocols, hot-swap, quotas ---------------
+
+
+def _http_predict(port, model, tenant, rows, timeout=30):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    try:
+        conn.request("POST", "/v1/predict",
+                     json.dumps({"model": model, "tenant": tenant,
+                                 "rows": rows.tolist()}),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, json.loads(r.read()), dict(r.getheaders())
+    finally:
+        conn.close()
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    """One live FleetServer over an MLP snapshot dir, shared by the
+    smoke tests; its sink collects the full stream for the schema
+    checks."""
+    tmp = tmp_path_factory.mktemp("fleet")
+    d = tmp / "models"
+    d.mkdir()
+    _save_mlp_snapshot(d / "0001.model.npz", seed=0)
+    sink = MemorySink()
+    mon = Monitor(sink)
+    from cxxnet_tpu.utils.config import parse_config
+    cfg = parse_config(FLEET_MLP_CONF) + [
+        ("serve_models", "main=%s" % d),
+        ("serve_http_port", "0"), ("serve_binary_port", "0"),
+        ("serve_swap_poll_s", "0.05"),
+        ("serve_max_delay_ms", "1"),
+        ("serve_queue_rows", "4096"),
+        # free tenant: 5 rows/s with a 2-row burst — even this slow
+        # 1-core host's closed-loop hammer exceeds it immediately;
+        # everyone else unlimited
+        ("serve_quota", "free:5:2"),
+    ]
+    server = FleetServer(cfg, monitor=mon)
+    server.start()
+    yield server, sink, d
+    server.close()
+
+
+def test_fleet_http_and_binary_roundtrip(fleet):
+    server, sink, _ = fleet
+    rows = np.random.RandomState(0).rand(3, 64).astype(np.float32)
+    code, body, _ = _http_predict(server.http_port, "main", "gold",
+                                  rows)
+    assert code == 200 and body["rows"] == 3
+    assert len(body["result"]) == 3 and len(body["result"][0]) == 4
+    bc = BinaryClient("127.0.0.1", server.binary_port)
+    try:
+        status, out = bc.predict(rows, model="main", tenant="gold")
+        assert status == "ok" and out.shape == (3, 4)
+        # both protocols answer from the same engine
+        np.testing.assert_allclose(out, np.asarray(body["result"]),
+                                   rtol=1e-5, atol=1e-6)
+        # unknown model: typed reply, connection stays usable
+        status, msg = bc.predict(rows, model="ghost", tenant="gold")
+        assert status == "unknown_model" and "ghost" in msg
+        status, out = bc.predict(rows, model="", tenant="gold")
+        assert status == "ok"              # "" routes to the default
+    finally:
+        bc.close()
+
+
+def test_fleet_http_introspection_and_bad_requests(fleet):
+    import http.client
+    server, _, _ = fleet
+    conn = http.client.HTTPConnection("127.0.0.1", server.http_port,
+                                      timeout=30)
+    try:
+        conn.request("GET", "/healthz")
+        r = conn.getresponse()
+        assert r.status == 200
+        assert json.loads(r.read())["models"] == ["main"]
+        conn.request("GET", "/v1/models")
+        r = conn.getresponse()
+        models = json.loads(r.read())["models"]
+        assert r.status == 200
+        assert models[0]["model"] == "main"
+        assert models[0]["row_elems"] == 64
+        assert models[0]["max_batch"] == 16
+        # malformed body and wrong row shape are this caller's 400,
+        # not a worker crash
+        conn.request("POST", "/v1/predict", "not json")
+        assert conn.getresponse().read() is not None
+        conn.request("POST", "/v1/predict",
+                     json.dumps({"rows": [[1.0, 2.0]]}))
+        r = conn.getresponse()
+        assert r.status == 400
+        assert json.loads(r.read())["error"] == "bad_request"
+        conn.request("POST", "/v1/predict",
+                     json.dumps({"model": "ghost",
+                                 "rows": [[0.0] * 64]}))
+        r = conn.getresponse()
+        assert r.status == 404
+        assert json.loads(r.read())["error"] == "unknown_model"
+    finally:
+        conn.close()
+
+
+def test_fleet_smoke_hot_swap_and_quota_under_traffic(fleet):
+    """The ISSUE 6 acceptance smoke: concurrent HTTP + binary clients,
+    one hot-swap mid-traffic with zero failed requests and zero
+    post-warmup compiles on both engines, the over-quota tenant shed
+    with the typed busy reply while in-quota tenants all succeed."""
+    server, sink, model_dir = fleet
+    rng = np.random.RandomState(1)
+    pool = rng.rand(32, 64).astype(np.float32)
+    stop = threading.Event()
+    counts = {"http_ok": 0, "http_fail": [], "bin_ok": 0,
+              "bin_fail": [], "free_ok": 0, "free_shed": 0,
+              "free_other": []}
+    lock = threading.Lock()
+
+    def http_client(ci):
+        while not stop.is_set():
+            rows = pool[(ci * 3) % 16:(ci * 3) % 16 + 2]
+            code, body, _ = _http_predict(server.http_port, "main",
+                                          "gold", rows)
+            with lock:
+                if code == 200:
+                    counts["http_ok"] += 1
+                else:
+                    counts["http_fail"].append((code, body))
+
+    def bin_client(ci):
+        bc = BinaryClient("127.0.0.1", server.binary_port)
+        try:
+            while not stop.is_set():
+                rows = pool[(ci * 5) % 16:(ci * 5) % 16 + 3]
+                status, out = bc.predict(rows, model="main",
+                                         tenant="team-%d" % ci)
+                with lock:
+                    if status == "ok":
+                        counts["bin_ok"] += 1
+                    else:
+                        counts["bin_fail"].append((status, out))
+        finally:
+            bc.close()
+
+    def free_client():
+        """Over-quota hammer: 2-row burst at 5 rows/s against a
+        closed loop of 1-row requests — sheds almost immediately."""
+        while not stop.is_set():
+            try:
+                code, body, headers = _http_predict(
+                    server.http_port, "main", "free", pool[:1])
+            except Exception as e:
+                with lock:
+                    counts["free_other"].append(("exc", repr(e)))
+                continue
+            with lock:
+                if code == 200:
+                    counts["free_ok"] += 1
+                elif (code == 429
+                      and body.get("error") == "over_quota"
+                      and "Retry-After" in headers):
+                    counts["free_shed"] += 1
+                else:
+                    counts["free_other"].append((code, body))
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=http_client, args=(i,))
+               for i in range(2)]
+    threads += [threading.Thread(target=bin_client, args=(i,))
+                for i in range(2)]
+    threads.append(threading.Thread(target=free_client))
+    for t in threads:
+        t.start()
+    try:
+        # let traffic establish, then commit a new verified snapshot
+        # mid-flight; the watcher (50 ms poll) must shadow-build,
+        # flip, and drain with zero failed requests
+        time.sleep(0.4)
+        _save_mlp_snapshot(model_dir / "0002.model.npz", seed=7)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(r["event"] == "hot_swap" for r in sink.records):
+                break
+            time.sleep(0.05)
+        time.sleep(0.4)                    # post-swap traffic window
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads)
+
+    # the swap happened, from counter 1 to 2, and the retired engine
+    # drained without a single steady-state compile
+    swaps = [r for r in sink.records if r["event"] == "hot_swap"]
+    assert len(swaps) == 1, swaps
+    assert swaps[0]["old_counter"] == 1
+    assert swaps[0]["new_counter"] == 2
+    assert swaps[0]["old_compile_events"] == 0
+    assert swaps[0]["warmup_programs"] > 0
+    entry = server.router.resolve("main")
+    assert entry.counter == 2 and entry.generation == 1
+
+    # zero failed requests for every in-quota tenant, across the swap
+    assert counts["http_fail"] == []
+    assert counts["bin_fail"] == []
+    assert counts["http_ok"] > 10 and counts["bin_ok"] > 10
+    # post-swap traffic actually ran on the new engine
+    assert server.router.resolve("main").session.batcher \
+        .counters["requests"] > 0
+
+    # the over-quota tenant was shed with the typed reply; its burst
+    # allowance went through
+    assert counts["free_shed"] > 0, counts
+    assert counts["free_other"] == [], counts
+    sheds = [r for r in sink.records if r["event"] == "tenant_shed"]
+    assert sheds and all(r["tenant"] == "free" for r in sheds)
+    assert all(r["rate"] == 5.0 and r["burst"] == 2.0 for r in sheds)
+
+    # zero post-warmup compiles on the NEW engine too
+    snap = entry.session.engine.counters_snapshot()
+    assert snap["compile_events"] == 0
+    assert snap["aot_hits"] == snap["dispatches"] > 0
+
+    # stream is schema-valid and carries every fleet record kind
+    errs = validate_records(sink.records, strict=False)
+    assert errs == [], errs[:5]
+    kinds = {r["event"] for r in sink.records}
+    assert {"serve_http", "tenant_shed", "hot_swap"} <= kinds
+    http_recs = [r for r in sink.records if r["event"] == "serve_http"]
+    assert {r["protocol"] for r in http_recs} == {"http", "binary"}
+
+
+def test_fleet_close_is_clean_and_typed(fleet):
+    """Runs LAST in the module (fixture teardown closes again,
+    idempotently): closing drains every engine and a post-close
+    request gets the typed closed/unreachable answer, not a hang."""
+    server, sink, _ = fleet
+    summary = server.close()
+    assert summary["requests"]["error"] == 0
+    for m_summary in summary["models"].values():
+        assert m_summary["compile_events"] == 0
+    # both engine dispatcher threads are gone
+    entry = server.router.resolve("main")
+    assert not entry.session.batcher._collector.is_alive()
+    assert not entry.session.batcher._dispatcher.is_alive()
+
+
+# -- task = serve_fleet through the CLI ----------------------------------
+
+
+def test_main_task_serve_fleet_runs_and_drains(tmp_path, monkeypatch):
+    from cxxnet_tpu.main import main
+    d = tmp_path / "models"
+    d.mkdir()
+    _save_mlp_snapshot(d / "0001.model.npz")
+    conf = tmp_path / "fleet.conf"
+    conf.write_text(FLEET_MLP_CONF + """
+task = serve_fleet
+model_dir = %s
+serve_http_port = 0
+serve_binary_port = -1
+serve_swap_poll_s = 0
+serve_fleet_duration_s = 0.3
+monitor = jsonl
+monitor_path = %s
+""" % (d, tmp_path / "fleet.jsonl"))
+    logs = []
+    monkeypatch.setattr("builtins.print",
+                        lambda *a, **k: logs.append(
+                            " ".join(map(str, a))))
+    rc = main([str(conf)])
+    monkeypatch.undo()
+    assert rc == 0, "\n".join(logs)
+    txt = "\n".join(logs)
+    assert "serve_fleet: listening" in txt
+    assert "hot-swaps" in txt
+    from cxxnet_tpu.monitor.schema import read_jsonl
+    records = read_jsonl(str(tmp_path / "fleet.jsonl"))
+    assert validate_records(records, strict=False) == []
+    events = [r["event"] for r in records]
+    assert "run_start" in events and "task_end" in events

@@ -8,7 +8,7 @@ import pytest
 from cxxnet_tpu.graph import NetGraph
 from cxxnet_tpu.utils.config import ConfigError, parse_config
 
-REF = "/root/reference"
+from tests.conftest import REFERENCE_DIR as REF, needs_reference
 
 
 def _graph_from(text):
@@ -17,6 +17,7 @@ def _graph_from(text):
     return g
 
 
+@needs_reference
 def test_mnist_conf_graph():
     with open(os.path.join(REF, "example/MNIST/MNIST.conf")) as f:
         g = NetGraph()
@@ -36,6 +37,7 @@ def test_mnist_conf_graph():
     assert all(("eta", "0.1") not in c for c in g.layercfg)
 
 
+@needs_reference
 def test_mnist_conv_conf_graph():
     with open(os.path.join(REF, "example/MNIST/MNIST_CONV.conf")) as f:
         g = NetGraph()
@@ -47,6 +49,7 @@ def test_mnist_conv_conf_graph():
     assert g.layers[3].nindex_in == g.layers[3].nindex_out
 
 
+@needs_reference
 def test_inception_graph_parses():
     with open(os.path.join(REF, "example/ImageNet/Inception-BN.conf")) as f:
         g = NetGraph()

@@ -445,6 +445,15 @@ def test_every_emitted_record_kind_has_a_validator():
     missing = {k: v for k, v in emitted.items() if k not in REQUIRED}
     assert not missing, \
         "record kinds emitted without a schema validator: %r" % missing
-    # and the serve records specifically are part of the contract
-    for kind in ("serve_request", "serve_batch", "serve_summary"):
+    # and the serve records specifically are part of the contract,
+    # including the fleet layer's protocol/quota/hot-swap kinds
+    for kind in ("serve_request", "serve_batch", "serve_summary",
+                 "serve_http", "tenant_shed", "hot_swap"):
         assert kind in REQUIRED
+    # the fleet kinds carry their load-bearing fields: a consumer must
+    # be able to split shed rate by tenant and swaps by model
+    assert "tenant" in REQUIRED["serve_http"]
+    assert "protocol" in REQUIRED["serve_http"]
+    assert "tenant" in REQUIRED["tenant_shed"]
+    assert "model" in REQUIRED["hot_swap"]
+    assert "warmup_programs" in REQUIRED["hot_swap"]

@@ -13,10 +13,24 @@ Default is a self-contained synthetic MLP on whatever platform jax
 picks (set ``JAX_PLATFORMS=cpu`` for the CPU smoke run); pass
 ``--conf``/``--model-in`` to sweep a real snapshot instead.
 
+``--tenants`` switches to the closed-loop **multi-tenant fleet
+scenario** (ROADMAP item 2): per-tenant client mixes with token-bucket
+quotas driven through the real binary-protocol front end
+(``serve/frontend.py``) — per-tenant ok/shed counts, shed rate, and
+latency p50/p99 read back from the ``serve_http`` records, plus a
+p99-SLO assertion: ``--slo-p99-ms`` makes the process exit 3 (distinct
+from 1 = post-warmup recompiles; argparse owns 2 — the ``bench.py``
+exit-code convention) when any tenant's ok-request p99 breaches the
+SLO. The point of quota shedding is that *surviving* requests stay
+fast — the SLO applies to every tenant's completed requests, shed or
+not.
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/serve_bench.py --clients 1,2,4,8
     python tools/serve_bench.py --conf run.conf --model-in 0010.model.npz
+    JAX_PLATFORMS=cpu python tools/serve_bench.py \
+        --tenants gold:4,free:4:50:8 --slo-p99-ms 250
 """
 
 from __future__ import annotations
@@ -112,6 +126,185 @@ def sweep_point(args, clients, monitor, sink):
     }
 
 
+def parse_tenants(spec):
+    """``name:clients[:rate[:burst]]`` comma list -> list of dicts
+    (rate 0/absent = unlimited; burst defaults to the rate)."""
+    out = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                "tenant spec %r must be name:clients[:rate[:burst]]"
+                % entry)
+        out.append({
+            "tenant": parts[0],
+            "clients": int(parts[1]),
+            "rate": float(parts[2]) if len(parts) > 2 else 0.0,
+            "burst": float(parts[3]) if len(parts) > 3
+            else (float(parts[2]) if len(parts) > 2 else 0.0),
+        })
+    if not out:
+        raise ValueError("empty --tenants spec")
+    return out
+
+
+def run_multi_tenant(args, monitor, sink):
+    """The closed-loop multi-tenant fleet scenario: every tenant's
+    clients drive the real binary-protocol front end; quotas shed the
+    over-quota mix with the typed reply; stats come back from the
+    schema-validated ``serve_http`` records. Returns (record,
+    slo_ok, zero_recompiles)."""
+    import tempfile
+    import threading
+
+    from cxxnet_tpu.monitor.schema import validate_records
+    from cxxnet_tpu.serve import BinaryClient, FleetServer
+    from cxxnet_tpu.utils.config import parse_config, parse_config_file
+
+    tenants = parse_tenants(args.tenants)
+    quota = ",".join("%s:%g:%g" % (t["tenant"], t["rate"], t["burst"])
+                     for t in tenants if t["rate"] > 0)
+    serve_pairs = [
+        ("serve_buckets", args.buckets),
+        ("serve_max_delay_ms", str(args.max_delay_ms)),
+        ("serve_queue_rows", str(args.queue_rows)),
+        ("serve_http_port", "-1"),
+        ("serve_binary_port", "0"),
+        ("serve_swap_poll_s", "0"),
+    ]
+    if quota:
+        serve_pairs.append(("serve_quota", quota))
+    sink.clear()
+    with tempfile.TemporaryDirectory() as td:
+        if args.conf:
+            assert args.model_in, "--conf needs --model-in"
+            cfg = parse_config_file(args.conf)
+            model_src = args.model_in
+        else:
+            from cxxnet_tpu.nnet.trainer import NetTrainer
+            from cxxnet_tpu.parallel import make_mesh
+            cfg = parse_config(SYNTH_CONF)
+            trainer = NetTrainer(cfg, mesh=make_mesh(1, 1))
+            trainer.init_model()
+            model_src = os.path.join(td, "0001.model.npz")
+            trainer.save_model(model_src)
+        fleet = FleetServer(
+            cfg + serve_pairs + [("serve_models",
+                                  "bench=%s" % model_src)],
+            monitor=monitor)
+        fleet.start()
+        inst = fleet.router.resolve("bench").session.engine \
+            ._inst_shape()
+        rng = np.random.RandomState(0)
+        pool = rng.uniform(0, 1, size=(256,) + inst) \
+            .astype(np.float32)
+        counts = {t["tenant"]: {"ok": 0, "shed": 0, "errors": 0}
+                  for t in tenants}
+        lock = threading.Lock()
+        t0 = time.time()
+
+        def client(tenant, ci):
+            bc = BinaryClient("127.0.0.1", fleet.binary_port)
+            try:
+                for r in range(args.requests):
+                    start = (ci * args.requests + r) \
+                        * args.request_rows % 256
+                    rows = np.take(
+                        pool, range(start, start + args.request_rows),
+                        axis=0, mode="wrap")
+                    try:
+                        status, _ = bc.predict(rows, tenant=tenant)
+                    except Exception:
+                        # dead transport (socket timeout, dropped
+                        # connection): the requests this client never
+                        # completed must show up as errors, not
+                        # silently shrink the sample the SLO gate
+                        # reads
+                        with lock:
+                            counts[tenant]["errors"] += \
+                                args.requests - r
+                        break
+                    with lock:
+                        if status == "ok":
+                            counts[tenant]["ok"] += 1
+                        elif status in ("over_quota", "busy"):
+                            counts[tenant]["shed"] += 1
+                        else:
+                            counts[tenant]["errors"] += 1
+            finally:
+                bc.close()
+
+        threads = [threading.Thread(target=client,
+                                    args=(t["tenant"], ci))
+                   for t in tenants for ci in range(t["clients"])]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.time() - t0
+        summary = fleet.close()
+    errs = validate_records(sink.records)
+    assert not errs, "schema-invalid fleet telemetry: %s" % errs[:5]
+    ok_lat = {}
+    for r in sink.records:
+        if r["event"] == "serve_http" and r["status"] == "ok":
+            ok_lat.setdefault(r["tenant"], []).append(r["latency_ms"])
+    rows_out, slo_ok = [], True
+    for t in tenants:
+        name = t["tenant"]
+        c = counts[name]
+        lat = sorted(ok_lat.get(name, []))
+
+        def pct(q):
+            return round(lat[min(len(lat) - 1,
+                                 int(q * len(lat)))], 3) if lat else 0.0
+
+        p99 = pct(0.99)
+        total = c["ok"] + c["shed"] + c["errors"]
+        breach = bool(args.slo_p99_ms and lat
+                      and p99 > args.slo_p99_ms)
+        slo_ok = slo_ok and not breach
+        rows_out.append({
+            "tenant": name, "clients": t["clients"],
+            "rate": t["rate"], "burst": t["burst"],
+            "requests_ok": c["ok"], "requests_shed": c["shed"],
+            "requests_error": c["errors"],
+            "shed_rate": round(c["shed"] / total, 4) if total else 0.0,
+            "latency_p50_ms": pct(0.50), "latency_p99_ms": p99,
+            "rows_per_sec": round(
+                c["ok"] * args.request_rows / wall, 2),
+            "slo_breach": breach,
+        })
+        print("# tenant=%s: %d ok / %d shed (rate %.2f), p50 %.2f ms"
+              ", p99 %.2f ms%s"
+              % (name, c["ok"], c["shed"], rows_out[-1]["shed_rate"],
+                 rows_out[-1]["latency_p50_ms"], p99,
+                 " SLO-BREACH" if breach else ""), file=sys.stderr)
+    zero_recompiles = all(
+        m.get("compile_events", 0) == 0
+        for m in summary["models"].values())
+    rec = {
+        "name": "serve_bench",
+        "mode": "multi_tenant",
+        "t": time.time(),
+        "model": args.conf or "synthetic_mlp_256_64_10",
+        "buckets": args.buckets,
+        "max_delay_ms": args.max_delay_ms,
+        "requests_per_client": args.requests,
+        "request_rows": args.request_rows,
+        "wall_s": round(wall, 2),
+        "slo_p99_ms": args.slo_p99_ms,
+        "slo_ok": slo_ok,
+        "tenants": rows_out,
+        "zero_recompiles": zero_recompiles,
+        "quota": summary["quota"],
+    }
+    return rec, slo_ok, zero_recompiles
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", default="1,2,4,8",
@@ -128,12 +321,34 @@ def main(argv=None) -> int:
     ap.add_argument("--model-in", default="")
     ap.add_argument("--out", default="",
                     help="also write the JSON record to this path")
+    ap.add_argument("--tenants", default="",
+                    help="multi-tenant scenario: comma list of "
+                         "name:clients[:rate[:burst]] (rate in "
+                         "rows/s; 0 = unlimited)")
+    ap.add_argument("--slo-p99-ms", type=float, default=0.0,
+                    help="per-tenant ok-request p99 SLO; breach "
+                         "exits 3 (0 = no assertion)")
     args = ap.parse_args(argv)
 
     from cxxnet_tpu.monitor import MemorySink, Monitor
     import jax
     sink = MemorySink()
     monitor = Monitor(sink)
+    if args.tenants:
+        rec, slo_ok, zero_recompiles = run_multi_tenant(
+            args, monitor, sink)
+        rec["platform"] = jax.default_backend()
+        out = json.dumps(rec, sort_keys=True)
+        print(out)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(out + "\n")
+        # exit-code convention (bench.py): 1 = the capture itself is
+        # bad (post-warmup recompiles), 2 = argparse usage, 3 = the
+        # measured fleet breached its latency SLO
+        if not zero_recompiles:
+            return 1
+        return 0 if slo_ok else 3
     points = []
     for clients in [int(t) for t in args.clients.split(",") if t]:
         t0 = time.time()
