@@ -88,7 +88,13 @@ check: all
 check-fast: all
 	python -m pytest tests/ -q --ignore=tests/test_mnist_e2e.py
 
+# cxxlint: the framework-aware static-analysis suite
+# (doc/static_analysis.md). Exit 0 clean / 1 findings / 2 usage; also
+# enforced inside tier-1 by tests/test_lint.py::test_tree_is_lint_clean.
+lint:
+	python -m cxxnet_tpu.lint cxxnet_tpu/ tools/ --format json
+
 clean:
 	rm -rf lib bin
 
-.PHONY: all clean mex-smoke mex-driver check check-fast
+.PHONY: all clean mex-smoke mex-driver check check-fast lint

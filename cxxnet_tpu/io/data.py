@@ -116,6 +116,13 @@ def resolve_data_shard(part_index: int, num_parts: int):
         import jax
         if jax.process_count() > 1:
             return jax.process_index(), jax.process_count()
-    except Exception:
-        pass
+    except Exception as e:
+        # a failed autodetect in a real multi-process run would make
+        # every rank read the SAME shard (silently duplicated data) —
+        # say so instead of passing
+        from ..monitor import warn_once
+        warn_once("shard_autodetect_failed",
+                  "distributed shard autodetect failed (%s); "
+                  "assuming single process — set part_index/num_parts "
+                  "explicitly if this is a multi-process run" % e)
     return 0, 1

@@ -86,8 +86,15 @@ class ImageRecordIterator(IIterator):
             if jax.process_count() > 1:
                 self.dist_num_parts = jax.process_count()
                 self.dist_part_index = jax.process_index()
-        except Exception:
-            pass
+        except Exception as e:
+            # same hazard as resolve_data_shard: every rank reading the
+            # whole archive is silent data duplication
+            from ..monitor import warn_once
+            warn_once("shard_autodetect_failed",
+                      "distributed shard autodetect failed (%s); "
+                      "imgrec reads unsharded — set part_index/"
+                      "num_parts explicitly for multi-process runs"
+                      % e)
 
     def init(self) -> None:
         assert self.path_imgrec, "imgrec: must set path_imgrec"

@@ -1,0 +1,313 @@
+"""Shared AST machinery: qualified names, per-class call graphs,
+thread-entry detection, and lock-context-aware body walks.
+
+Scope model: functions get dotted qualnames (``Class.method``,
+``Class.method.inner``); statements directly in a class body belong to
+the enclosing module scope. Decorators and default-argument
+expressions are evaluated in the *enclosing* scope, not inside the
+function they decorate — ``@partial(jax.jit, ...)`` on a module-level
+function is a module-scope jit reference, which is exactly the
+distinction the recompile check needs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+LOCK_FACTORIES = ("Lock", "RLock", "Condition")
+
+
+class FuncInfo:
+    """One function/method (including nested defs)."""
+
+    __slots__ = ("qualname", "name", "node", "cls", "lineno", "parent")
+
+    def __init__(self, qualname: str, node, cls: Optional[str],
+                 parent: Optional[str]):
+        self.qualname = qualname
+        self.name = node.name
+        self.node = node
+        self.cls = cls          # innermost enclosing class, if any
+        self.parent = parent    # enclosing function qualname, if any
+        self.lineno = node.lineno
+
+    @property
+    def is_public(self) -> bool:
+        return not self.name.startswith("_")
+
+
+class ModuleIndex:
+    """Functions of one module plus the scope of every expression."""
+
+    def __init__(self, tree: ast.AST):
+        self.functions: Dict[str, FuncInfo] = {}
+        # scope of non-def nodes: maps id(node) -> (qualname, cls);
+        # "<module>" for module scope
+        self.scope_of: Dict[int, Tuple[str, Optional[str]]] = {}
+        self._index(tree, "", None, None)
+
+    def _index(self, node, prefix: str, cls: Optional[str],
+               parent: Optional[str]) -> None:
+        scope = parent if parent is not None else "<module>"
+        self.scope_of[id(node)] = (scope, cls)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qn = prefix + node.name
+            self.functions[qn] = FuncInfo(qn, node, cls, parent)
+            # decorators/defaults evaluate in the enclosing scope
+            for d in node.decorator_list:
+                self._walk_into(d, prefix, cls, parent)
+            for d in list(node.args.defaults) + \
+                    [x for x in node.args.kw_defaults if x is not None]:
+                self._walk_into(d, prefix, cls, parent)
+            for stmt in node.body:
+                self._index(stmt, qn + ".", cls, qn)
+            return
+        if isinstance(node, ast.ClassDef):
+            for d in node.decorator_list + node.bases:
+                self._walk_into(d, prefix, cls, parent)
+            for stmt in node.body:
+                self._index(stmt, node.name + ".", node.name, parent)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._index(child, prefix, cls, parent)
+
+    def _walk_into(self, node, prefix, cls, parent) -> None:
+        for n in ast.walk(node):
+            self.scope_of[id(n)] = (
+                parent if parent is not None else "<module>", cls)
+
+    # -- queries ----------------------------------------------------------
+
+    def scope(self, node) -> str:
+        return self.scope_of.get(id(node), ("<module>", None))[0]
+
+    def class_of(self, node) -> Optional[str]:
+        return self.scope_of.get(id(node), ("<module>", None))[1]
+
+    def methods_of(self, cls: str) -> List[FuncInfo]:
+        """All functions belonging to class ``cls`` (methods AND
+        functions nested inside them — a closure submitted to a worker
+        still runs with the instance's ``self`` in scope)."""
+        return [f for f in self.functions.values() if f.cls == cls]
+
+    def resolve_bare(self, name: str,
+                     from_qualname: str) -> Optional[str]:
+        """Resolve a bare-name call/reference from inside
+        ``from_qualname``: innermost nested def first, then enclosing
+        scopes, then module level."""
+        scope = from_qualname
+        while scope:
+            cand = scope + "." + name
+            if cand in self.functions:
+                return cand
+            scope = scope.rpartition(".")[0]
+        return name if name in self.functions else None
+
+
+def body_walk(funcnode) -> Iterator[ast.AST]:
+    """Walk a function's own body, NOT descending into nested
+    function/class definitions (their bodies are separate scopes) —
+    but still yielding the def nodes themselves."""
+    stack = list(funcnode.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def call_edges(idx: ModuleIndex, fi: FuncInfo) -> Set[str]:
+    """Qualnames this function may call: ``self.m(...)`` resolved
+    within its class, bare names resolved lexically."""
+    out: Set[str] = set()
+    for node in body_walk(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and fn.value.id == "self" \
+                and fi.cls is not None:
+            cand = fi.cls + "." + fn.attr
+            if cand in idx.functions:
+                out.add(cand)
+        elif isinstance(fn, ast.Name):
+            cand = idx.resolve_bare(fn.id, fi.qualname)
+            if cand is not None:
+                out.add(cand)
+    return out
+
+
+def reachable(idx: ModuleIndex, roots: Set[str]) -> Set[str]:
+    """Transitive closure of :func:`call_edges` from ``roots``."""
+    seen: Set[str] = set()
+    stack = [r for r in roots if r in idx.functions]
+    while stack:
+        qn = stack.pop()
+        if qn in seen:
+            continue
+        seen.add(qn)
+        for nxt in call_edges(idx, idx.functions[qn]):
+            if nxt not in seen:
+                stack.append(nxt)
+    return seen
+
+
+def thread_roots(idx: ModuleIndex, tree: ast.AST) -> Set[str]:
+    """Functions that run on a spawned thread:
+
+    - ``threading.Thread(target=self.m)`` / ``Thread(target=f)`` —
+      the target method/local function;
+    - ``<anything>.submit(f)`` where ``f`` is a local def — worker
+      submission (the async checkpoint writer's pattern). The callee
+      name is not resolved (any executor-like object counts); this is
+      deliberately conservative in the "more findings" direction.
+    """
+    roots: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        callee = fn.attr if isinstance(fn, ast.Attribute) else \
+            fn.id if isinstance(fn, ast.Name) else ""
+        if callee == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    roots.update(_resolve_ref(idx, node, kw.value))
+        elif callee == "submit" and node.args:
+            ref = _resolve_ref(idx, node, node.args[0])
+            # only local defs: executor.submit(some_import) is opaque
+            roots.update(r for r in ref if "." in r or
+                         r in idx.functions)
+    return roots
+
+
+def _resolve_ref(idx: ModuleIndex, at_node, expr) -> Set[str]:
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        cls = idx.class_of(at_node)
+        if cls is not None and cls + "." + expr.attr in idx.functions:
+            return {cls + "." + expr.attr}
+        return set()
+    if isinstance(expr, ast.Name):
+        cand = idx.resolve_bare(expr.id, idx.scope(at_node))
+        return {cand} if cand else set()
+    return set()
+
+
+def declared_locks(idx: ModuleIndex, cls: str) -> Set[str]:
+    """Instance attributes assigned a ``threading.Lock/RLock/
+    Condition`` anywhere in the class."""
+    locks: Set[str] = set()
+    for fi in idx.methods_of(cls):
+        for node in body_walk(fi.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            if not (isinstance(v, ast.Call) and _is_lock_factory(v.func)):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    locks.add(t.attr)
+    return locks
+
+
+def _is_lock_factory(fn) -> bool:
+    name = fn.attr if isinstance(fn, ast.Attribute) else \
+        fn.id if isinstance(fn, ast.Name) else ""
+    return name in LOCK_FACTORIES
+
+
+def locked_walk(funcnode, lock_attrs: Set[str]
+                ) -> Iterator[Tuple[ast.AST, bool]]:
+    """Yield (node, holding_lock) over a function body, where
+    ``holding_lock`` is True inside ``with self.<lock>:`` for any
+    declared lock attribute. Does not descend into nested defs."""
+
+    def rec(node, locked):
+        yield node, locked
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = locked
+            for item in node.items:
+                for n, lk in rec(item.context_expr, locked):
+                    yield n, lk
+                if item.optional_vars is not None:
+                    for n, lk in rec(item.optional_vars, locked):
+                        yield n, lk
+                ce = item.context_expr
+                if isinstance(ce, ast.Attribute) and \
+                        isinstance(ce.value, ast.Name) and \
+                        ce.value.id == "self" and ce.attr in lock_attrs:
+                    inner = True
+            for stmt in node.body:
+                for n, lk in rec(stmt, inner):
+                    yield n, lk
+            return
+        for child in ast.iter_child_nodes(node):
+            for n, lk in rec(child, locked):
+                yield n, lk
+
+    for stmt in funcnode.body:
+        for n, lk in rec(stmt, False):
+            yield n, lk
+
+
+def self_attr_writes(funcnode, lock_attrs: Set[str]
+                     ) -> List[Tuple[str, int, bool]]:
+    """(attr, line, locked) for every ``self.attr = / += ...`` in the
+    function body."""
+    out: List[Tuple[str, int, bool]] = []
+    for node, locked in locked_walk(funcnode, lock_attrs):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            for tt in _flatten_targets(t):
+                if isinstance(tt, ast.Attribute) and \
+                        isinstance(tt.value, ast.Name) and \
+                        tt.value.id == "self":
+                    out.append((tt.attr, node.lineno, locked))
+    return out
+
+
+def _flatten_targets(t):
+    if isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _flatten_targets(e)
+    else:
+        yield t
+
+
+def self_attr_uses(funcnode) -> Set[str]:
+    """Attributes of ``self`` referenced (any context) in the body."""
+    out: Set[str] = set()
+    for node in body_walk(funcnode):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            out.add(node.attr)
+    return out
+
+
+def dotted_name(node) -> str:
+    """Best-effort dotted rendering of a Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        parts.append(dotted_name(node.func) + "()")
+    else:
+        parts.append("<expr>")
+    return ".".join(reversed(parts))

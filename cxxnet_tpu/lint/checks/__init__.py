@@ -1,0 +1,7 @@
+"""Built-in check modules; importing this package populates the
+registry (core.all_checks). Add a new check by dropping a module here
+with a ``@register("CXL0NN", "name")`` function and importing it below
+— doc/static_analysis.md walks through a full example."""
+
+from . import (config_drift, hotpath, locks, recompile,  # noqa: F401
+               schema_drift, swallow)

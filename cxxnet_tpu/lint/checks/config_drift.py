@@ -1,0 +1,194 @@
+"""CXL005: config-key drift between the code and doc/*.md.
+
+The config surface is the user contract (PAPER.md: the reference is
+driven entirely by ``key = value`` files), but keys are consumed in
+a dozen ``set_param(name, val)`` / ``for name, val in cfg`` sites
+across the tree, and documented by hand in doc/*.md. The two drift:
+a new knob ships undocumented, or a doc table advertises a key no
+code reads. Both directions are findings:
+
+- **consumed-but-undocumented** — a key literal compared against the
+  config name (``name == "k"``, ``name in ("a", "b")``,
+  ``name.startswith("k")``) in a consumer context that never appears
+  as a word anywhere in doc/*.md. Finding at the consumption site.
+- **documented-but-unconsumed** — a key row of an authoritative
+  ``| key | ... |``-headed markdown table whose key no consumer
+  matches. Finding at the doc line; mark the row "deprecated" (or
+  remove it) if the key is intentionally dead. Keys consumed through
+  regex/computed patterns are declared in
+  ``lint.config.CONFIG_KEYS_PATTERN_CONSUMED`` with their real
+  consumer named.
+
+Consumer contexts are (a) functions whose first non-self parameters
+are literally ``(name, val)`` — the tree's set_param convention — and
+(b) ``for name, val in ...`` two-tuple loops (the config-pairs
+convention). A doc-side finding is suppressed with the usual directive
+in an HTML comment on the table row. The stale direction only runs
+when the scan includes ``lint.config.CONFIG_CONSUMER_ROOT`` (the main
+CLI's config consumer) — a partial scan must not call every
+documented key stale.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Set, Tuple
+
+from ..core import Finding, register
+
+_KEY_NORM = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*")
+_TABLE_HEAD = re.compile(r"^\|\s*key\s*\|", re.IGNORECASE)
+_CELL_KEYS = re.compile(r"`([^`]+)`")
+
+
+def _norm_key(text: str):
+    m = _KEY_NORM.match(text.strip())
+    return m.group(0) if m else None
+
+
+def _name_param_funcs(tree) -> List[ast.AST]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = [a.arg for a in node.args.args
+                      if a.arg not in ("self", "cls")]
+            if params[:2] == ["name", "val"]:
+                out.append(node)
+    return out
+
+
+def _tuple_loop_bodies(tree) -> List[ast.AST]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For) and \
+                isinstance(node.target, ast.Tuple) and \
+                len(node.target.elts) == 2 and \
+                isinstance(node.target.elts[0], ast.Name) and \
+                node.target.elts[0].id == "name":
+            out.append(node)
+    return out
+
+
+def _keys_in(scope_node, var: str = "name"
+             ) -> List[Tuple[str, int, bool]]:
+    """(key, line, is_prefix) literals matched against ``var``."""
+    found: List[Tuple[str, int, bool]] = []
+    for node in ast.walk(scope_node):
+        if isinstance(node, ast.Compare) and \
+                isinstance(node.left, ast.Name) and \
+                node.left.id == var and len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.Eq, ast.In, ast.NotEq)):
+            cmp = node.comparators[0]
+            consts = []
+            if isinstance(cmp, ast.Constant):
+                consts = [cmp]
+            elif isinstance(cmp, (ast.Tuple, ast.List, ast.Set)):
+                consts = [e for e in cmp.elts
+                          if isinstance(e, ast.Constant)]
+            for c in consts:
+                if isinstance(c.value, str):
+                    found.append((c.value, node.lineno, False))
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "startswith" and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == var and node.args:
+            a = node.args[0]
+            elts = [a] if isinstance(a, ast.Constant) else \
+                list(a.elts) if isinstance(a, (ast.Tuple, ast.List)) \
+                else []
+            for c in elts:
+                if isinstance(c, ast.Constant) and \
+                        isinstance(c.value, str):
+                    found.append((c.value, node.lineno, True))
+    return found
+
+
+def _consumed_keys(project) -> Dict[str, Tuple[str, int]]:
+    """normalized key -> first (path, line) consumption site."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for sf in project.pyfiles:
+        scopes = _name_param_funcs(sf.tree) + _tuple_loop_bodies(sf.tree)
+        for scope in scopes:
+            for key, line, _pref in _keys_in(scope):
+                k = _norm_key(key)
+                if k and k not in out:
+                    out[k] = (sf.rel, line)
+    return out
+
+
+def _doc_table_keys(project) -> List[Tuple[str, str, int, bool]]:
+    """(key, docpath, line, deprecated) from | key |-headed tables."""
+    rows: List[Tuple[str, str, int, bool]] = []
+    for df in project.docfiles:
+        in_table = False
+        for i, line in enumerate(df.lines, start=1):
+            if _TABLE_HEAD.match(line):
+                in_table = True
+                continue
+            if in_table:
+                if not line.lstrip().startswith("|"):
+                    in_table = False
+                    continue
+                cells = line.split("|")
+                if len(cells) < 3:
+                    continue
+                first = cells[1]
+                if set(first.strip()) <= {"-", ":", " "}:
+                    continue          # the |---|---| separator row
+                dep = "deprecated" in line.lower()
+                for m in _CELL_KEYS.finditer(first):
+                    k = _norm_key(m.group(1))
+                    if k:
+                        rows.append((k, df.rel, i, dep))
+    return rows
+
+
+def _word_in_docs(project, key: str) -> bool:
+    pat = re.compile(r"(?<![A-Za-z0-9_])%s(?![A-Za-z0-9_])"
+                     % re.escape(key))
+    for df in project.docfiles:
+        if pat.search(df.source):
+            return True
+    return False
+
+
+@register("CXL005", "config-drift")
+def check(project) -> Iterator[Finding]:
+    """Config keys consumed in code must appear in doc/*.md; keys in
+    authoritative doc tables must still have a consumer."""
+    if not project.docfiles:
+        return []
+    consumed = _consumed_keys(project)
+    out: List[Finding] = []
+    for key in sorted(consumed):
+        rel, line = consumed[key]
+        if not _word_in_docs(project, key):
+            out.append(Finding(
+                "CXL005", "config-drift", rel, line,
+                "undocumented:%s" % key,
+                "config key %r is consumed here but never mentioned "
+                "in doc/*.md — add it to the matching reference page"
+                % key))
+    if project.find_py(project.config.CONFIG_CONSUMER_ROOT) is None:
+        # partial scan: without the primary consumer in the scan set,
+        # "no consumer found" means "you didn't scan the consumers",
+        # not "the doc row is stale" — skip the stale direction (the
+        # undocumented direction above is per-file and already ran)
+        return out
+    pattern_ok = set(project.config.CONFIG_KEYS_PATTERN_CONSUMED)
+    seen_doc: Set[str] = set()
+    for key, rel, line, dep in _doc_table_keys(project):
+        if dep or key in consumed or key in pattern_ok or \
+                key in seen_doc:
+            continue
+        seen_doc.add(key)
+        out.append(Finding(
+            "CXL005", "config-drift", rel, line,
+            "stale-doc:%s" % key,
+            "documented config key %r has no consumer in the scanned "
+            "tree — remove the row, mark it deprecated, or (if it is "
+            "consumed via a pattern) declare it in "
+            "lint.config.CONFIG_KEYS_PATTERN_CONSUMED" % key))
+    return out

@@ -1,0 +1,113 @@
+"""CXL003: host sync on the hot path.
+
+The steady-state contract of this codebase is "the host is off the hot
+path" (PR 2) and "zero compiles, zero surprise syncs after warmup"
+(PR 4). A single stray ``np.asarray`` on a device value inside the
+dispatch loop serializes H2D/compute overlap; one ``.item()`` turns a
+pipelined step into a round trip. The hot-path roots are declared in
+``lint.config.HOT_PATH_ROOTS``; everything reachable from them in the
+same module is audited for the host-sync operators:
+
+- ``jax.device_get`` / ``jax.block_until_ready`` /
+  ``<x>.block_until_ready()``
+- ``<x>.item()`` / ``<x>.tolist()``
+- ``np.asarray`` / ``np.array`` (the tree's idiomatic D2H copy)
+
+Two finding flavors:
+
+- a plain hot-path sync — legitimate ones (metric copies, the
+  monitor-gated step timing sync, host-side input staging) carry an
+  inline suppression naming the justification, so every sync on the
+  path is accounted for;
+- a sync while HOLDING a declared lock — the convoy variant: every
+  other thread queues behind a device round trip. These should be
+  restructured (sync outside the critical section), not suppressed.
+
+Known limitation, by design: ``float(device_scalar)`` also syncs but
+``float()`` over host scalars is everywhere; flagging it would bury
+the signal. The operators above are the ones this tree uses for D2H.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from ..astutil import (ModuleIndex, declared_locks, locked_walk,
+                       reachable)
+from ..core import Finding, register
+
+_SYNC_METHOD = ("block_until_ready", "item", "tolist")
+_NP_FUNCS = ("asarray", "array")
+
+
+def _sync_desc(node) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in ("device_get", "block_until_ready") and \
+                isinstance(fn.value, ast.Name) and fn.value.id == "jax":
+            return "jax." + fn.attr
+        if fn.attr in _SYNC_METHOD and not isinstance(fn.value,
+                                                      ast.Name):
+            return "." + fn.attr + "()"
+        if isinstance(fn.value, ast.Name) and fn.attr in _SYNC_METHOD \
+                and fn.value.id not in ("np", "numpy", "math", "json"):
+            return "." + fn.attr + "()"
+        if fn.attr in _NP_FUNCS and isinstance(fn.value, ast.Name) \
+                and fn.value.id in ("np", "numpy"):
+            return "np." + fn.attr
+    return None
+
+
+@register("CXL003", "hotpath-host-sync")
+def check(project) -> Iterator[Finding]:
+    """Host-sync operators reachable from the declared hot-path roots
+    (lint.config.HOT_PATH_ROOTS); lock-held syncs flagged separately."""
+    out: List[Finding] = []
+    for sf in project.pyfiles:
+        roots: Set[str] = set()
+        for suffix, quals in project.config.HOT_PATH_ROOTS.items():
+            if sf.rel.endswith(suffix):
+                roots.update(quals)
+        if not roots:
+            continue
+        idx = ModuleIndex(sf.tree)
+        reach = reachable(idx, roots)
+        lock_cache = {}
+        for qn in sorted(reach):
+            fi = idx.functions[qn]
+            locks = set()
+            if fi.cls is not None:
+                if fi.cls not in lock_cache:
+                    lock_cache[fi.cls] = declared_locks(idx, fi.cls)
+                locks = lock_cache[fi.cls]
+            n_at_line: dict = {}
+            for node, locked in locked_walk(fi.node, locks):
+                desc = _sync_desc(node)
+                if desc is None:
+                    continue
+                i = n_at_line.setdefault(node.lineno, 0)
+                n_at_line[node.lineno] = i + 1
+                if locked:
+                    out.append(Finding(
+                        "CXL003", "hotpath-host-sync", sf.rel,
+                        node.lineno,
+                        "locked:%s:%s:%d" % (qn, desc, i),
+                        "%s inside a 'with self.<lock>:' block in %s "
+                        "(hot path): the device round trip convoys "
+                        "every thread waiting on the lock — move the "
+                        "sync outside the critical section"
+                        % (desc, qn)))
+                else:
+                    out.append(Finding(
+                        "CXL003", "hotpath-host-sync", sf.rel,
+                        node.lineno,
+                        "%s:%s:%d" % (qn, desc, i),
+                        "%s in %s is reachable from a hot-path root — "
+                        "if this host sync is intentional (host-side "
+                        "staging, monitor-gated timing, metric copy) "
+                        "suppress it with the reason; otherwise keep "
+                        "the value on device" % (desc, qn)))
+    return out

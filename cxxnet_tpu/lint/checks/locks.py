@@ -1,0 +1,100 @@
+"""CXL002: lock discipline — cross-thread instance state written
+unlocked.
+
+Six subsystems share threads (serve dispatcher, frontend, hot-swap
+watchers, async checkpoint writer, cv-queue prefetch); the class of
+bug this encodes is the one that forced JsonlSink's retrofitted write
+lock in PR 4: instance state mutated on a spawned thread while the
+main thread reads or writes it, with no lock between them.
+
+Model (per class, per module):
+
+- *declared locks* — attributes assigned ``threading.Lock/RLock/
+  Condition`` anywhere in the class;
+- *thread-reachable* — the same-module call-graph closure from every
+  ``threading.Thread(target=...)`` method/closure and every local
+  function handed to a worker via ``.submit(fn)`` (the async
+  checkpoint writer's pattern);
+- *main-reachable* — the closure from the class's public methods
+  (anything external callers invoke on the constructing thread).
+
+A write ``self.attr = ...`` in thread-reachable code, outside a
+``with self.<declared lock>:`` block, is a finding when the attribute
+is visible to the other side: it is public (external readers), or the
+writing function is also main-reachable (the watcher's ``check_once``
+pattern — same method runs on both threads), or the attribute is
+touched by a main-only method. ``__init__`` writes are construction,
+not sharing, and are exempt.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Set
+
+from ..astutil import (ModuleIndex, declared_locks, reachable,
+                       self_attr_uses, self_attr_writes, thread_roots)
+from ..core import Finding, register
+
+
+@register("CXL002", "lock-discipline")
+def check(project) -> Iterator[Finding]:
+    """Instance attributes written on a spawned thread without the
+    class's declared lock while the main thread can see them."""
+    out: List[Finding] = []
+    for sf in project.pyfiles:
+        idx = ModuleIndex(sf.tree)
+        roots = thread_roots(idx, sf.tree)
+        if not roots:
+            continue
+        # group the roots by owning class; module-level thread targets
+        # have no instance state for this check to reason about
+        by_cls: Dict[str, Set[str]] = {}
+        for r in roots:
+            cls = idx.functions[r].cls if r in idx.functions else None
+            if cls is not None:
+                by_cls.setdefault(cls, set()).add(r)
+        for cls, cls_roots in sorted(by_cls.items()):
+            locks = declared_locks(idx, cls)
+            thread_reach = reachable(idx, cls_roots)
+            public = {f.qualname for f in idx.methods_of(cls)
+                      if f.is_public and f.parent is None
+                      and f.name != "__init__"}
+            main_reach = reachable(idx, public)
+            # attributes a main-only method touches (read or write)
+            main_only_touch: Set[str] = set()
+            for fi in idx.methods_of(cls):
+                if fi.qualname in thread_reach or \
+                        fi.name == "__init__":
+                    continue
+                main_only_touch |= self_attr_uses(fi.node)
+            seen: Set[str] = set()
+            for qn in sorted(thread_reach):
+                fi = idx.functions.get(qn)
+                if fi is None or fi.cls != cls or fi.name == "__init__":
+                    continue
+                for attr, line, locked in \
+                        self_attr_writes(fi.node, locks):
+                    if locked or attr in locks:
+                        continue
+                    shared = (not attr.startswith("_")) \
+                        or qn in main_reach \
+                        or attr in main_only_touch
+                    if not shared:
+                        continue
+                    key = "%s.%s" % (cls, attr)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    out.append(Finding(
+                        "CXL002", "lock-discipline", sf.rel, line,
+                        key,
+                        "%s.%s is written in %s (runs on a spawned "
+                        "thread) without holding a declared lock%s — "
+                        "the main thread can observe a torn/stale "
+                        "value; guard the write (and its readers) "
+                        "with a lock" % (
+                            cls, attr, qn,
+                            " (class declares: %s)"
+                            % ", ".join(sorted(locks)) if locks
+                            else " (class declares no lock)")))
+    return out

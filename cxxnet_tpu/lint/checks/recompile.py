@@ -1,0 +1,76 @@
+"""CXL001: recompile hazard — program construction outside the
+registry.
+
+AOT program construction was once duplicated across four call sites
+(trainer precompile, serve engine, bench, pred) before PR 4 collapsed
+them onto the single-sourced ``pred_sig`` key scheme. A fifth copy
+would reintroduce the silent-recompile class of bug: a signature built
+slightly differently compiles its own executable in the hot path and
+the zero-compile-after-warmup contract dies by a thousand cache
+misses. This check makes the registry mechanical: any reference to
+``jax.jit`` / ``pjit`` or any ``.lower(<args>)`` call outside
+``lint.config.PROGRAM_BUILDERS`` is a finding.
+
+``.lower()`` with NO arguments is ignored — that is ``str.lower``;
+jax's AOT entry takes the example arguments being lowered for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from ..astutil import ModuleIndex, dotted_name
+from ..core import Finding, register
+
+_JIT_ATTRS = ("jit", "pjit")
+
+
+def _is_jit_ref(node) -> bool:
+    if isinstance(node, ast.Name) and node.id == "pjit":
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in _JIT_ATTRS:
+        v = node.value
+        return isinstance(v, ast.Name) and v.id in ("jax", "pjit")
+    return False
+
+
+def _allowed(rel: str, qualname: str, config) -> bool:
+    for suffix, quals in config.PROGRAM_BUILDERS.items():
+        if rel.endswith(suffix):
+            for q in quals:
+                if qualname == q or qualname.startswith(q + "."):
+                    return True
+    return False
+
+
+@register("CXL001", "recompile-hazard")
+def check(project) -> Iterator[Finding]:
+    """jax.jit / pjit / .lower(args) outside the program-build
+    registry (lint.config.PROGRAM_BUILDERS)."""
+    out: List[Finding] = []
+    for sf in project.pyfiles:
+        idx = ModuleIndex(sf.tree)
+        for node in ast.walk(sf.tree):
+            what = None
+            if _is_jit_ref(node):
+                what = dotted_name(node)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "lower" and \
+                    (node.args or node.keywords):
+                what = dotted_name(node.func) + "(...)"
+            if what is None:
+                continue
+            qn = idx.scope(node)
+            if _allowed(sf.rel, qn, project.config):
+                continue
+            out.append(Finding(
+                "CXL001", "recompile-hazard", sf.rel, node.lineno,
+                "%s:%s" % (qn, what),
+                "%s in %s builds an XLA program outside the program "
+                "registry — route it through NetTrainer.precompile/"
+                "precompile_pred (pred_sig key scheme) or add the "
+                "function to lint.config.PROGRAM_BUILDERS in a "
+                "reviewed diff" % (what, qn)))
+    return out

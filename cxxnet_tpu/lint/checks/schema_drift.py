@@ -1,0 +1,125 @@
+"""CXL004: telemetry schema drift.
+
+Every record kind the tree emits must have a REQUIRED validator in
+``monitor/schema.py``, and every validator must still have an emitter
+— both directions, with file:line findings. This is the promotion of
+the old grep-driven guard in tests/test_serve.py to a real AST pass:
+the grep pattern (``\\bemit\\(``) could not see the serve layer's
+``self._emit("serve_request", ...)`` wrapper emitters because ``_`` is
+a word character, so five serving record kinds were invisible to the
+guard that existed to protect them.
+
+Emit sites are calls to a function/method named ``emit`` or ``_emit``
+whose first positional argument (or ``event=``/``kind=`` keyword) is a
+string literal; forwarding shims (``self._mon.emit(kind, ...)``) pass
+a variable and are naturally skipped. The REQUIRED map is read
+statically from the AST of the schema module found among the scanned
+files (``lint.config.SCHEMA_MODULE`` suffix). A scan that sees emit
+sites but no schema module is itself a finding (the old grep guard's
+"pattern rotted" assert, kept): run the linter over the package root,
+as the tier-1 gate does, and the check can never become a silent
+no-op because the schema moved.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Tuple
+
+from ..core import Finding, register
+
+_EMIT_NAMES = ("emit", "_emit")
+
+
+def _emit_kind(node: ast.Call):
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else \
+        fn.id if isinstance(fn, ast.Name) else ""
+    if name not in _EMIT_NAMES:
+        return None
+    if node.args and isinstance(node.args[0], ast.Constant) and \
+            isinstance(node.args[0].value, str):
+        return node.args[0].value
+    for kw in node.keywords:
+        if kw.arg in ("event", "kind") and \
+                isinstance(kw.value, ast.Constant) and \
+                isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def _required_map(schema_sf) -> Dict[str, int]:
+    """kind -> line of its key in the REQUIRED dict literal."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(schema_sf.tree):
+        target = None
+        if isinstance(node, ast.Assign) and node.targets:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        if not (isinstance(target, ast.Name) and
+                target.id == "REQUIRED"):
+            continue
+        if isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    out[k.value] = k.lineno
+    return out
+
+
+@register("CXL004", "schema-drift")
+def check(project) -> Iterator[Finding]:
+    """Every literal emit() kind has a REQUIRED validator and every
+    validator still has an emitter (monitor/schema.py)."""
+    schema_sf = project.find_py(project.config.SCHEMA_MODULE)
+    emitted: Dict[str, List[Tuple[str, int]]] = {}
+    for sf in project.pyfiles:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                kind = _emit_kind(node)
+                if kind is not None:
+                    emitted.setdefault(kind, []).append(
+                        (sf.rel, node.lineno))
+    if schema_sf is None:
+        if not emitted:
+            return []                 # nothing to validate, no schema
+        # anti-rot (the old grep guard's "pattern rotted" assert): a
+        # scan that SEES emit sites but cannot find the schema module
+        # must fail loudly, not silently stop validating — otherwise a
+        # moved/renamed schema.py (or a stale SCHEMA_MODULE constant)
+        # turns the whole check into a no-op while the gate stays green
+        first_kind = sorted(emitted)[0]
+        rel, line = emitted[first_kind][0]
+        return [Finding(
+            "CXL004", "schema-drift", rel, line,
+            "no-schema-module",
+            "%d emit site(s) found but no %r in the scan set — scan "
+            "the package root (the schema module must be included for "
+            "kinds to be validated), or update lint.config."
+            "SCHEMA_MODULE if the schema moved"
+            % (sum(len(v) for v in emitted.values()),
+               project.config.SCHEMA_MODULE))]
+    required = _required_map(schema_sf)
+    out: List[Finding] = []
+    for kind in sorted(emitted):
+        if kind in required:
+            continue
+        rel, line = emitted[kind][0]
+        out.append(Finding(
+            "CXL004", "schema-drift", rel, line,
+            "unvalidated:%s" % kind,
+            "record kind %r is emitted here but has no REQUIRED "
+            "validator in %s — a consumer cannot trust the stream; "
+            "add the entry (and its required fields) to the schema"
+            % (kind, schema_sf.rel)))
+    for kind in sorted(required):
+        if kind in emitted:
+            continue
+        out.append(Finding(
+            "CXL004", "schema-drift", schema_sf.rel, required[kind],
+            "orphan-validator:%s" % kind,
+            "REQUIRED entry %r has no emit site anywhere in the "
+            "scanned tree — dead schema vocabulary; delete the entry "
+            "or restore the emitter" % kind))
+    return out

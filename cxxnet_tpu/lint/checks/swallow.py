@@ -1,0 +1,48 @@
+"""CXL006: silent exception swallows.
+
+``except: pass`` hides real failures until they surface as corrupt
+output — PR 1's metric-allreduce fallback failed silently for a whole
+round before it was converted to a warn-once. Any exception handler
+whose body is nothing but ``pass`` is a finding; survivors must either
+become a ``monitor.warn_once`` (the tree's warn-exactly-once
+convention) or carry a suppression whose reason says why silence is
+correct (e.g. a racing ``Future`` already resolved, best-effort
+cleanup on an exit path).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from ..astutil import ModuleIndex, dotted_name
+from ..core import Finding, register
+
+
+@register("CXL006", "silent-swallow")
+def check(project) -> Iterator[Finding]:
+    """except-handlers whose body is only ``pass``."""
+    out: List[Finding] = []
+    for sf in project.pyfiles:
+        idx = ModuleIndex(sf.tree)
+        seen = {}
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not all(isinstance(s, ast.Pass) for s in node.body):
+                continue
+            exc = dotted_name(node.type) if node.type is not None \
+                else "<bare>"
+            qn = idx.scope(node)
+            i = seen.setdefault((qn, exc), 0)
+            seen[(qn, exc)] = i + 1
+            # anchored at the pass statement: that is where the
+            # suppression comment naturally lives
+            out.append(Finding(
+                "CXL006", "silent-swallow", sf.rel,
+                node.body[0].lineno,
+                "%s:%s:%d" % (qn, exc, i),
+                "except %s: pass in %s swallows the failure silently "
+                "— warn once (monitor.warn_once) or suppress with the "
+                "reason silence is correct" % (exc, qn)))
+    return out

@@ -1,0 +1,72 @@
+"""Repo-specific knowledge the checks key off.
+
+These maps are the single place where "which functions may build XLA
+programs" and "which functions are the serving/training hot path" are
+declared. A new AOT call site or hot-path root is a one-line diff here
+— reviewed as such — instead of an invisible new compile hazard.
+Paths are matched as ``/``-separated suffixes of the scanned file
+path, so the maps work from any checkout root.
+"""
+
+# -- CXL001: the program-construction registry ----------------------------
+# The ONLY code allowed to call jax.jit / pjit / .lower(...): the
+# trainer's single-sourced program builders (PR 4 collapsed four
+# duplicated AOT sites into these) and the Pallas kernel module's
+# module-level decorators. Everything else must route through
+# NetTrainer.precompile / precompile_pred / the engine, which share the
+# pred_sig key scheme — a fifth duplicate program-build site fails the
+# gate instead of shipping a silent recompile hazard.
+PROGRAM_BUILDERS = {
+    "cxxnet_tpu/nnet/trainer.py": (
+        "NetTrainer._build_steps",
+        "NetTrainer.precompile",
+        "NetTrainer.precompile_pred",
+        "NetTrainer._compile_programs",
+    ),
+    "cxxnet_tpu/layers/pallas_kernels.py": ("<module>",),
+}
+
+# -- CXL003: hot-path roots -----------------------------------------------
+# Functions on the steady-state throughput path: the per-dispatch train
+# loop and the serve stage/dispatch pair. Anything reachable from these
+# (same-module call graph) that forces a host sync — np.asarray /
+# device_get / block_until_ready / .item() / .tolist() — is either a
+# measured, justified sync (inline suppression with the reason) or a
+# regression.
+HOT_PATH_ROOTS = {
+    "cxxnet_tpu/nnet/trainer.py": (
+        "NetTrainer.update",
+        "NetTrainer.update_many",
+        "NetTrainer.run_steps",
+    ),
+    "cxxnet_tpu/serve/engine.py": (
+        "InferenceEngine.stage",
+        "InferenceEngine.dispatch",
+    ),
+    "cxxnet_tpu/serve/batcher.py": (
+        "DynamicBatcher._collect_loop",
+        "DynamicBatcher._dispatch_loop",
+    ),
+}
+
+# -- CXL004: telemetry schema ---------------------------------------------
+# The module holding the REQUIRED validator map, matched by suffix.
+SCHEMA_MODULE = "monitor/schema.py"
+
+# -- CXL005: config-key drift ---------------------------------------------
+# The stale-doc direction (documented key with no consumer) only runs
+# when the scan set includes the primary config consumer below — a
+# partial scan (one file + the real doc/ tree) must not call every
+# documented key stale. The undocumented direction runs per-file
+# regardless.
+CONFIG_CONSUMER_ROOT = "cxxnet_tpu/main.py"
+
+# Keys consumed through a pattern the literal scanner cannot see (regex
+# or computed-prefix matching). Each entry names its real consumer so
+# the allowlist is auditable.
+CONFIG_KEYS_PATTERN_CONSUMED = {
+    "metric": "nnet/trainer.py _RE_METRIC (metric / metric[field,node])",
+    "label_vec": "io/data.py label_vec[a,b) range binding",
+    "extra_data_shape": "io/data.py extra_data_shape[i] indexed keys",
+    "layer": "graph.py netconfig layer[from->to] section grammar",
+}

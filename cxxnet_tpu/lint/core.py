@@ -1,0 +1,383 @@
+"""Check registry, suppression/baseline machinery, and the runner.
+
+A check is a function ``check(project) -> iterable[Finding]`` decorated
+with :func:`register`. The runner parses every target file once into a
+:class:`Project`, runs each registered check over it, then applies the
+two escape hatches in order:
+
+1. inline suppressions — ``# cxxlint: disable=<code> -- <reason>`` on
+   the finding's line (or a standalone comment on the line above). The
+   reason is mandatory; a reasonless or unused suppression is itself a
+   finding (CXL000), so the suppression inventory can never rot.
+   Markdown targets use the same directive in an HTML comment;
+   directives inside fenced code blocks are ignored (doc examples).
+2. the committed baseline — grandfathered findings keyed by
+   ``(code, path, key)`` where ``key`` is a stable fingerprint (an
+   attribute name, a config key, an emit kind — never a line number),
+   so baselined findings survive unrelated edits but a *new* instance
+   of an old problem still fails the gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+# finding codes are CXL0NN; CXL000 is reserved for lint-directive
+# hygiene (bad/unused suppressions, unparseable files)
+CODE_RE = re.compile(r"^CXL\d{3}$")
+
+_SUPPRESS_RE = re.compile(
+    r"cxxlint:\s*disable=([A-Za-z0-9_,\s]+?)"
+    r"(?:\s*--\s*(.*?))?\s*$")
+# the HTML-comment close is stripped BEFORE matching: otherwise the
+# '--' of '-->' reads as the reason separator and a reasonless
+# markdown directive would sneak through with reason '>'
+_MD_CLOSE_RE = re.compile(r"\s*-->\s*$")
+
+
+class LintError(Exception):
+    """Usage-level failure (bad path, unreadable baseline): exit 2."""
+
+
+class Finding:
+    """One finding. ``key`` is the stable identity used for baseline
+    matching; ``line`` is for humans and suppression matching only."""
+
+    __slots__ = ("code", "check", "path", "line", "key", "message")
+
+    def __init__(self, code: str, check: str, path: str, line: int,
+                 key: str, message: str):
+        assert CODE_RE.match(code), code
+        self.code = code
+        self.check = check
+        self.path = path
+        self.line = int(line)
+        self.key = key
+        self.message = message
+
+    def fingerprint(self) -> Tuple[str, str, str]:
+        return (self.code, self.path, self.key)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"code": self.code, "check": self.check,
+                "path": self.path, "line": self.line,
+                "key": self.key, "message": self.message}
+
+    def render(self) -> str:
+        return "%s:%d: %s [%s] %s" % (self.path, self.line, self.code,
+                                      self.check, self.message)
+
+
+class Suppression:
+    __slots__ = ("line", "codes", "reason", "used")
+
+    def __init__(self, line: int, codes: List[str], reason: str):
+        self.line = line
+        self.codes = codes
+        self.reason = reason
+        self.used = False
+
+
+class SourceFile:
+    """One parsed target: Python (``tree`` set) or markdown/other
+    (``tree`` None). ``rel`` is the path as given, posix-separated —
+    the stable path used in findings and the baseline."""
+
+    def __init__(self, rel: str, source: str,
+                 tree: Optional[ast.AST] = None):
+        self.rel = rel
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.suppressions: Dict[int, Suppression] = {}
+        self._scan_suppressions()
+
+    def _scan_suppressions(self) -> None:
+        is_md = self.rel.endswith(".md")
+        in_fence = False
+        for i, line in enumerate(self.lines, start=1):
+            if is_md and line.lstrip().startswith("```"):
+                in_fence = not in_fence
+                continue
+            if in_fence or "cxxlint:" not in line:
+                continue
+            work = _MD_CLOSE_RE.sub("", line) if "<!--" in line \
+                else line
+            m = _SUPPRESS_RE.search(work)
+            if m is None:
+                continue
+            codes = [c.strip() for c in m.group(1).split(",")
+                     if c.strip()]
+            reason = (m.group(2) or "").strip()
+            # a standalone comment line suppresses the NEXT line;
+            # a trailing comment suppresses its own line
+            stripped = line.strip()
+            target = i + 1 if stripped.startswith(("#", "<!--")) else i
+            self.suppressions[target] = Suppression(i, codes, reason)
+
+
+class Project:
+    """Everything the checks see: parsed Python files plus raw doc
+    pages, with the config constants resolved once."""
+
+    def __init__(self, pyfiles: List[SourceFile],
+                 docfiles: List[SourceFile], config):
+        self.pyfiles = pyfiles
+        self.docfiles = docfiles
+        self.config = config
+
+    def find_py(self, suffix: str) -> Optional[SourceFile]:
+        for f in self.pyfiles:
+            if f.rel.endswith(suffix):
+                return f
+        return None
+
+
+class Check:
+    __slots__ = ("code", "name", "doc", "fn")
+
+    def __init__(self, code: str, name: str, doc: str, fn: Callable):
+        self.code = code
+        self.name = name
+        self.doc = doc
+        self.fn = fn
+
+
+_REGISTRY: Dict[str, Check] = {}
+
+
+def register(code: str, name: str):
+    """Class-registry decorator: ``@register("CXL00N", "check-name")``
+    over a function ``check(project) -> iterable[Finding]``. The
+    function docstring becomes the ``--list-checks`` description."""
+    assert CODE_RE.match(code), code
+
+    def deco(fn):
+        assert code not in _REGISTRY, "duplicate check code %s" % code
+        _REGISTRY[code] = Check(code, name, (fn.__doc__ or "").strip(),
+                                fn)
+        return fn
+    return deco
+
+
+def all_checks() -> List[Check]:
+    _load_builtin_checks()
+    return [_REGISTRY[c] for c in sorted(_REGISTRY)]
+
+
+def _load_builtin_checks() -> None:
+    from . import checks as _checks  # noqa: F401  (import populates)
+
+
+class LintResult:
+    def __init__(self):
+        self.findings: List[Finding] = []      # live (reported)
+        self.suppressed: List[Tuple[Finding, str]] = []
+        self.baselined: List[Finding] = []
+        self.files_scanned = 0
+        self.checks_run: List[str] = []
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "findings": [f.as_dict() for f in self.findings],
+            "counts": {"findings": len(self.findings),
+                       "suppressed": len(self.suppressed),
+                       "baselined": len(self.baselined),
+                       "files": self.files_scanned},
+            "checks": self.checks_run,
+        }
+
+
+# -- target collection ----------------------------------------------------
+
+
+def _norm(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def collect_py_paths(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, files in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif os.path.isfile(p):
+            out.append(p)
+        else:
+            raise LintError("no such file or directory: %r" % p)
+    return out
+
+
+def load_project(paths: Iterable[str], doc_dir: Optional[str],
+                 config) -> Tuple[Project, List[Finding]]:
+    """Parse every target; unparseable Python is a CXL000 finding, not
+    a crash (the gate must report the file, not die on it)."""
+    parse_errors: List[Finding] = []
+    pyfiles: List[SourceFile] = []
+    for path in collect_py_paths(paths):
+        rel = _norm(path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError as e:
+            raise LintError("cannot read %s: %s" % (path, e))
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            parse_errors.append(Finding(
+                "CXL000", "lint-hygiene", rel, e.lineno or 1,
+                "parse-error",
+                "file does not parse: %s" % e.msg))
+            continue
+        pyfiles.append(SourceFile(rel, src, tree))
+    docfiles: List[SourceFile] = []
+    if doc_dir and os.path.isdir(doc_dir):
+        for fn in sorted(os.listdir(doc_dir)):
+            if fn.endswith(".md"):
+                path = os.path.join(doc_dir, fn)
+                with open(path, encoding="utf-8") as f:
+                    docfiles.append(SourceFile(_norm(path), f.read()))
+    return Project(pyfiles, docfiles, config), parse_errors
+
+
+# -- baseline -------------------------------------------------------------
+
+
+def load_baseline(path: str) -> set:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError as e:
+        raise LintError("cannot read baseline %s: %s" % (path, e))
+    except ValueError as e:
+        raise LintError("baseline %s is not valid JSON: %s" % (path, e))
+    if not isinstance(data, dict) or "findings" not in data:
+        raise LintError("baseline %s: expected {\"findings\": [...]}"
+                        % path)
+    out = set()
+    for ent in data["findings"]:
+        try:
+            out.add((ent["code"], ent["path"], ent["key"]))
+        except (KeyError, TypeError) as e:
+            # a malformed entry is a usage error (exit 2), not a
+            # traceback that reads as "findings present" (exit 1)
+            raise LintError(
+                "baseline %s: entry %r is missing code/path/key (%s)"
+                % (path, ent, e))
+    return out
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    ents = sorted({f.fingerprint() for f in findings})
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"comment": "grandfathered cxxlint findings; "
+                              "regenerate with --write-baseline",
+                   "findings": [{"code": c, "path": p, "key": k}
+                                for c, p, k in ents]},
+                  f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+# -- runner ---------------------------------------------------------------
+
+
+def run_lint(paths: Iterable[str], doc_dir: Optional[str] = None,
+             baseline_path: Optional[str] = None,
+             select: Optional[Iterable[str]] = None,
+             config=None) -> LintResult:
+    """Run the registered checks; returns a :class:`LintResult` whose
+    ``findings`` are the live (unsuppressed, unbaselined) ones."""
+    if config is None:
+        from . import config as config  # repo defaults
+    checks = all_checks()
+    known = {c.code for c in checks} | {"CXL000"}
+    if select is not None:
+        sel = set(select)
+        bad = sel - known
+        if bad:
+            raise LintError("unknown check code(s): %s"
+                            % ", ".join(sorted(bad)))
+        checks = [c for c in checks if c.code in sel]
+    project, raw = load_project(paths, doc_dir, config)
+    result = LintResult()
+    result.files_scanned = len(project.pyfiles) + len(project.docfiles)
+    result.checks_run = [c.code for c in checks]
+    for check in checks:
+        for f in check.fn(project):
+            raw.append(f)
+
+    # -- suppressions ----------------------------------------------------
+    by_rel = {f.rel: f for f in project.pyfiles}
+    by_rel.update({f.rel: f for f in project.docfiles})
+    live: List[Finding] = []
+    for f in raw:
+        sf = by_rel.get(f.path)
+        sup = sf.suppressions.get(f.line) if sf is not None else None
+        if sup is not None and f.code in sup.codes and sup.reason:
+            sup.used = True
+            result.suppressed.append((f, sup.reason))
+        else:
+            live.append(f)
+    # directive hygiene: reasons are mandatory, dead suppressions and
+    # unknown codes are findings — the escape hatch cannot rot silently
+    for sf in list(project.pyfiles) + list(project.docfiles):
+        for sup in sf.suppressions.values():
+            if not sup.reason:
+                live.append(Finding(
+                    "CXL000", "lint-hygiene", sf.rel, sup.line,
+                    "missing-reason:%d" % sup.line,
+                    "suppression without a reason: use "
+                    "'cxxlint: disable=%s -- <why>'"
+                    % ",".join(sup.codes)))
+            for c in sup.codes:
+                if c not in known:
+                    live.append(Finding(
+                        "CXL000", "lint-hygiene", sf.rel, sup.line,
+                        "unknown-code:%s:%d" % (c, sup.line),
+                        "suppression names unknown check %r" % c))
+            if sup.reason and not sup.used \
+                    and all(c in known for c in sup.codes):
+                # only meaningful when the suppressed checks actually
+                # ran — a --select run must not flag the rest as dead
+                ran = set(result.checks_run) | {"CXL000"}
+                if any(c in ran for c in sup.codes):
+                    live.append(Finding(
+                        "CXL000", "lint-hygiene", sf.rel, sup.line,
+                        "unused:%d" % sup.line,
+                        "unused suppression (nothing fires here "
+                        "anymore): remove it"))
+
+    # -- baseline --------------------------------------------------------
+    baseline = load_baseline(baseline_path) if baseline_path else set()
+    for f in live:
+        if f.fingerprint() in baseline:
+            result.baselined.append(f)
+        else:
+            result.findings.append(f)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.code, f.key))
+    return result
+
+
+# -- output ---------------------------------------------------------------
+
+
+def render_human(result: LintResult) -> str:
+    out = [f.render() for f in result.findings]
+    out.append("cxxlint: %d finding(s), %d suppressed, %d baselined, "
+               "%d file(s) scanned, checks: %s"
+               % (len(result.findings), len(result.suppressed),
+                  len(result.baselined), result.files_scanned,
+                  " ".join(result.checks_run)))
+    return "\n".join(out)
+
+
+def render_json(result: LintResult) -> str:
+    return json.dumps(result.as_dict(), indent=1, sort_keys=True)

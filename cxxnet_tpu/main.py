@@ -418,8 +418,14 @@ class LearnTask:
         for s in (signal.SIGTERM, signal.SIGINT):
             try:
                 installed.append((s, signal.signal(s, _on_signal)))
-            except (ValueError, OSError):
-                pass
+            except (ValueError, OSError) as e:
+                # without the handler a preemption kills the process
+                # mid-round instead of snapshotting — worth a warning
+                self._mon.warn_once(
+                    "preempt_handler_unavailable",
+                    "cannot install handler for signal %s (%s); "
+                    "preemption will not trigger an emergency "
+                    "snapshot" % (s, e))
         return installed
 
     @staticmethod
@@ -428,7 +434,7 @@ class LearnTask:
             try:
                 signal.signal(s, old)
             except (ValueError, OSError, TypeError):
-                pass
+                pass  # cxxlint: disable=CXL006 -- best-effort restore on the exit path; install already warned when signals are unavailable
 
     def _preempt_now(self) -> bool:
         """True when any rank has a pending preemption signal. Multi-

@@ -240,6 +240,9 @@ class Monitor:
         self._tracing = False
         self._trace_started = False
         self._trace_round = trace_begin
+        # the warn-once latch is touched from worker threads (serve,
+        # checkpoint writer, prefetch) as well as the main thread
+        self._warn_lock = threading.Lock()
         self._warned = set()
 
     @property
@@ -262,13 +265,23 @@ class Monitor:
     def warn_once(self, code: str, message: str) -> None:
         """Once-per-run structured warning; also surfaces on stderr so
         a silent fallback (e.g. distributed metric reduction failing)
-        is visible even with monitor = none."""
-        if code in self._warned:
-            return
-        self._warned.add(code)
+        is visible even with monitor = none.
+
+        NEVER raises: warn_once is called from fallback/cleanup paths
+        that were infallible before they warned (shard autodetect, dir
+        fsync on the checkpoint writer thread), and a dead sink must
+        not turn a warning into a crash — or flip a successful async
+        commit into a recorded failure."""
+        with self._warn_lock:
+            if code in self._warned:
+                return
+            self._warned.add(code)
         sys.stderr.write("[cxxnet_tpu monitor] warning %s: %s\n"
                          % (code, message))
-        self.emit("warning", code=code, message=message)
+        try:
+            self.emit("warning", code=code, message=message)
+        except Exception:
+            pass  # cxxlint: disable=CXL006 -- the stderr line above already delivered the warning; a dead sink must not make warn_once raise
 
     # -- profiler trace window ------------------------------------------
 
@@ -437,8 +450,37 @@ def device_memory_snapshot() -> Dict[str, Any]:
 
 # -- global registry (the warn-once channel for deep call sites) ---------
 
+class SafeEmitter:
+    """Emit wrapper for worker-thread telemetry: a sink failure (full
+    disk, closed file) must neither kill the emitting thread nor spam
+    — the first failure prints ONE stderr line (latched under a lock:
+    emitters run on several threads at once) and serving/training
+    continues without records. The single implementation of the latch
+    the serve batcher and fleet frontend both need."""
+
+    def __init__(self, monitor, label: str):
+        self._mon = monitor
+        self._label = label
+        self._lock = threading.Lock()
+        self._broken = False
+
+    def __call__(self, kind: str, **fields: Any) -> None:
+        if self._mon is None or not self._mon.enabled:
+            return
+        try:
+            self._mon.emit(kind, **fields)
+        except Exception as e:
+            with self._lock:
+                already, self._broken = self._broken, True
+            if not already:
+                print("%s: telemetry emit failed (continuing without "
+                      "records): %s" % (self._label, e),
+                      file=sys.stderr)
+
+
 _global_monitor: Optional[Monitor] = None
 _fallback_warned: set = set()
+_fallback_lock = threading.Lock()
 
 
 def set_global(mon: Optional[Monitor]) -> None:
@@ -459,8 +501,9 @@ def warn_once(code: str, message: str) -> None:
     if _global_monitor is not None:
         _global_monitor.warn_once(code, message)
         return
-    if code in _fallback_warned:
-        return
-    _fallback_warned.add(code)
+    with _fallback_lock:
+        if code in _fallback_warned:
+            return
+        _fallback_warned.add(code)
     sys.stderr.write("[cxxnet_tpu monitor] warning %s: %s\n"
                      % (code, message))

@@ -167,7 +167,7 @@ def write_snapshot(path: str, arrays: Dict[str, np.ndarray],
             try:
                 os.remove(tmp)
             except OSError:
-                pass
+                pass  # cxxlint: disable=CXL006 -- best-effort cleanup; the commit failure below is what the caller must see
             raise
         if fsync and d:
             # the rename itself must be durable: fsync the directory
@@ -178,8 +178,15 @@ def write_snapshot(path: str, arrays: Dict[str, np.ndarray],
                     os.fsync(dfd)
                 finally:
                     os.close(dfd)
-            except OSError:
-                pass            # some filesystems refuse dir fsync
+            except OSError as e:
+                # some filesystems refuse dir fsync: the rename may
+                # not be power-loss durable — warn once, keep going
+                from ..monitor import warn_once
+                warn_once("dir_fsync_refused",
+                          "directory fsync of %r failed (%s); the "
+                          "snapshot rename is not guaranteed durable "
+                          "across power loss on this filesystem"
+                          % (d, e))
             fsync_s += time.perf_counter() - tf
         t2 = time.perf_counter()
     return {
@@ -354,8 +361,15 @@ def quarantine_snapshot(model_dir: str, name: str) -> None:
         try:
             with open_stream(uri + QUARANTINE_SUFFIX, "w") as f:
                 f.write("quarantined by resume scan\n")
-        except (IOError, OSError):
-            pass                         # skip-only quarantine
+        except (IOError, OSError) as e:
+            # skip-only quarantine on read-only remote stores: the
+            # resume scan still skips the corrupt snapshot, but every
+            # future scan re-verifies it — worth saying once
+            from ..monitor import warn_once
+            warn_once("quarantine_failed:%s" % uri,
+                      "could not write quarantine marker for %s (%s); "
+                      "the snapshot is skipped but will be re-verified "
+                      "on every scan" % (uri, e))
         return
     dst = uri + QUARANTINE_SUFFIX
     n = 0
@@ -364,8 +378,12 @@ def quarantine_snapshot(model_dir: str, name: str) -> None:
         dst = "%s%s.%d" % (uri, QUARANTINE_SUFFIX, n)
     try:
         os.replace(uri, dst)
-    except OSError:
-        pass
+    except OSError as e:
+        from ..monitor import warn_once
+        warn_once("quarantine_failed:%s" % uri,
+                  "could not quarantine corrupt snapshot %s (%s); it "
+                  "stays in place and every scan re-verifies it"
+                  % (uri, e))
 
 
 def find_latest_valid(model_dir: str, monitor=None,
@@ -381,7 +399,7 @@ def find_latest_valid(model_dir: str, monitor=None,
                 try:
                     os.remove(snapshot_uri(model_dir, n))
                 except OSError:
-                    pass
+                    pass  # cxxlint: disable=CXL006 -- stale .tmp sweep is an optimization; resume ignores tmp files either way
     bad: List[str] = []
     scanned = 0
     for counter, name in scan_snapshots(model_dir):
@@ -471,6 +489,10 @@ class CheckpointManager:
         self.fsync = bool(fsync)
         self.keep = int(keep)
         self._writer = _Writer()
+        # commits/failures are written on the background writer thread
+        # and read by the training thread (tests, the emergency path's
+        # accounting) — guarded, so a reader never sees a torn update
+        self._lock = threading.Lock()
         self.failures = 0
         self.commits = 0
 
@@ -495,13 +517,15 @@ class CheckpointManager:
             try:
                 stats = write_snapshot(path, arrays, meta,
                                        fsync=self.fsync)
-                self.commits += 1
+                with self._lock:
+                    self.commits += 1
             except Exception as e:
                 # commit failures (ENOSPC, auth, a backend bug) warn
                 # and keep training — and must never escape as an
                 # unhandled exception on the writer thread
                 status, err = "failed", str(e)
-                self.failures += 1
+                with self._lock:
+                    self.failures += 1
                 if self._mon is not None:
                     self._mon.warn_once(
                         "checkpoint_write_failed",

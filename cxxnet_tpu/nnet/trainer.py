@@ -624,15 +624,22 @@ class NetTrainer:
             try:
                 jax.config.update(k, v)
             except Exception:            # knob not in this jax version
-                pass
+                pass  # cxxlint: disable=CXL006 -- optional cache-tuning knob; absence on older jax is expected and harmless
         try:
             # drop the 'cache disabled' state memoized by any compile
             # that ran before the dir was configured (library init,
             # net.init) — without this the dir is set but never written
             from jax._src import compilation_cache as _cc
             _cc.reset_cache()
-        except Exception:
-            pass
+        except Exception as e:
+            # the user configured compile_cache_dir: if the memoized
+            # 'disabled' state cannot be dropped the cache may never
+            # be written — say so once instead of silently not caching
+            from ..monitor import warn_once
+            warn_once("compile_cache_reset_failed",
+                      "could not reset the jax compilation cache "
+                      "state (%s); compile_cache_dir may not take "
+                      "effect for programs compiled before init" % e)
 
     def precompile(self, window: int = 1, n_steps: int = 0,
                    per_batch: bool = True) -> int:
@@ -933,7 +940,7 @@ class NetTrainer:
         placed by the prefetch transform come back via local shards)."""
         if isinstance(batch.label, jax.Array):
             return self._local_rows(batch.label).astype(np.float32)
-        return np.asarray(batch.label, np.float32)
+        return np.asarray(batch.label, np.float32)  # cxxlint: disable=CXL003 -- host ring-buffer labels; no device value involved
 
     def _ship(self, arr: np.ndarray, sharding) -> jnp.ndarray:
         """Cast-and-transfer policy shared by per-batch and K-window
@@ -943,7 +950,7 @@ class NetTrainer:
         split across ranks like the reference splits across PS
         workers)."""
         if arr.dtype != np.uint8:
-            arr = np.asarray(arr, np.float32)
+            arr = np.asarray(arr, np.float32)  # cxxlint: disable=CXL003 -- host-side cast before the H2D ship; input is host numpy
         if jax.process_count() > 1:
             return jax.make_array_from_process_local_data(sharding, arr)
         # spatial batches take the row-major layout pin (channels on
@@ -953,7 +960,7 @@ class NetTrainer:
     def _put_batch_array(self, x) -> jnp.ndarray:
         if isinstance(x, jax.Array) and x.sharding == self._b_shard:
             return x                      # already resident (test_skipread)
-        return self._ship(np.asarray(x), self._b_shard)
+        return self._ship(np.asarray(x), self._b_shard)  # cxxlint: disable=CXL003 -- host staging of the input batch (jax.Array case returned above)
 
     def _put_mask(self, batch: DataBatch):
         m = self._mask(batch)
@@ -993,7 +1000,7 @@ class NetTrainer:
         if any(isinstance(a, jax.Array) for a in arrs):
             return self._stack_k(*[self._put_batch_array(a)
                                    for a in arrs])
-        return self._ship(np.stack([np.asarray(a) for a in arrs]),
+        return self._ship(np.stack([np.asarray(a) for a in arrs]),  # cxxlint: disable=CXL003 -- host-side window stack; device arrays took the _stack_k branch above
                           self._kb_shard)
 
     def _local_rows(self, arr, flatten: bool = True,
@@ -1011,13 +1018,13 @@ class NetTrainer:
         each row slice appears once per model-axis device. ``flatten``
         collapses the trailing dims to the as_mat 2-D view."""
         if jax.process_count() == 1:
-            out = np.asarray(arr)
+            out = np.asarray(arr)  # cxxlint: disable=CXL003 -- intentional D2H: _local_rows exists to fetch rows for host metrics/output
         else:
             uniq = {}
             for s in arr.addressable_shards:
                 uniq.setdefault(s.index[axis].start or 0, s)
             out = np.concatenate(
-                [np.asarray(uniq[k].data) for k in sorted(uniq)],
+                [np.asarray(uniq[k].data) for k in sorted(uniq)],  # cxxlint: disable=CXL003 -- intentional D2H of local shards (see above)
                 axis=axis)
         if not flatten:
             return out
@@ -1162,7 +1169,7 @@ class NetTrainer:
         ex = self._local_batch_size(batch) - batch.num_batch_padd
         self._count_examples(ex)
         if self._mon_on():
-            jax.block_until_ready(loss)
+            jax.block_until_ready(loss)  # cxxlint: disable=CXL003 -- monitor-gated: wall_ms must cover device compute; unmonitored runs never sync
             wall = time.perf_counter() - t0
             self._emit_step("update", 1, ex, wall, sig,
                             float(hyper[0, 0]) if len(hyper) else 0.0)
@@ -1194,8 +1201,8 @@ class NetTrainer:
         S, U = self.sample_counter, self.update_counter
         epochs = [U + (S + i) // period for i in range(n)]
         hyper_k = np.stack([self._hyper(e) for e in epochs])
-        epoch_k = np.asarray(epochs, np.uint32)
-        do_up_k = np.asarray([((S + i + 1) % period) == 0
+        epoch_k = np.asarray(epochs, np.uint32)  # cxxlint: disable=CXL003 -- host python list of schedule epochs
+        do_up_k = np.asarray([((S + i + 1) % period) == 0  # cxxlint: disable=CXL003 -- host python list of apply flags
                               for i in range(n)])
         sig = (data.shape, str(data.dtype), labels.shape,
                mask is None, len(extra), n)
@@ -1210,7 +1217,7 @@ class NetTrainer:
         ex = (self._local_batch_size(batch) - batch.num_batch_padd) * n
         self._count_examples(ex)
         if self._mon_on():
-            jax.block_until_ready(loss)
+            jax.block_until_ready(loss)  # cxxlint: disable=CXL003 -- monitor-gated: wall_ms must cover device compute; unmonitored runs never sync
             wall = time.perf_counter() - t0
             self._emit_step("run_steps", n, ex, wall, sig,
                             float(hyper_k[0, 0, 0]) if hyper_k.size
@@ -1239,8 +1246,8 @@ class NetTrainer:
         S, U = self.sample_counter, self.update_counter
         epochs = [U + (S + i) // period for i in range(K)]
         hyper_k = np.stack([self._hyper(e) for e in epochs])
-        epoch_k = np.asarray(epochs, np.uint32)
-        do_up = np.asarray([((S + i + 1) % period) == 0
+        epoch_k = np.asarray(epochs, np.uint32)  # cxxlint: disable=CXL003 -- host python list of schedule epochs
+        do_up = np.asarray([((S + i + 1) % period) == 0  # cxxlint: disable=CXL003 -- host python list of apply flags
                             for i in range(K)])
         step0 = self._step_scalar()
         data_k = self._put_window([b.data for b in batches])
@@ -1273,7 +1280,7 @@ class NetTrainer:
                  for b in batches)
         self._count_examples(ex)
         if self._mon_on():
-            jax.block_until_ready(loss)
+            jax.block_until_ready(loss)  # cxxlint: disable=CXL003 -- monitor-gated: wall_ms must cover device compute; unmonitored runs never sync
             wall = time.perf_counter() - t0
             self._emit_step("update_many", K, ex, wall, sig,
                             float(hyper_k[0, 0, 0]) if hyper_k.size

@@ -148,14 +148,22 @@ def init_distributed(coordinator: Optional[str] = None,
     global _distributed_up
     if _distributed_up:
         return
+    coordinator = coordinator or os.environ.get("CXXNET_COORDINATOR")
     try:  # a launcher may have called jax.distributed.initialize itself
         from jax._src import distributed as _jdist
         if getattr(_jdist.global_state, "client", None) is not None:
             _distributed_up = True
             return
-    except Exception:
-        pass
-    coordinator = coordinator or os.environ.get("CXXNET_COORDINATOR")
+    except Exception as e:
+        # only worth a warning when an initialize is actually coming:
+        # a single-process run (no coordinator) returns right below
+        # and must not print scary distributed warnings
+        if coordinator:
+            from ..monitor import warn_once
+            warn_once("distributed_probe_failed",
+                      "cannot probe jax distributed state (%s); if a "
+                      "launcher already initialized it, the "
+                      "initialize below may fail" % e)
     if not coordinator:
         return
     try:

@@ -35,7 +35,6 @@ aggregate fill/pad, rejection and timeout counts).
 from __future__ import annotations
 
 import queue
-import sys
 import threading
 import time
 from collections import deque
@@ -44,7 +43,7 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
-from ..monitor import LatencyHistogram
+from ..monitor import LatencyHistogram, SafeEmitter
 
 
 class ServeBusyError(RuntimeError):
@@ -66,7 +65,7 @@ def _set_exception(future: Future, exc: BaseException) -> None:
     try:
         future.set_exception(exc)
     except InvalidStateError:
-        pass
+        pass  # cxxlint: disable=CXL006 -- client cancelled first; the failure has no recipient and the docstring is the contract
 
 
 class _Request:
@@ -109,7 +108,6 @@ class DynamicBatcher:
                 "max_queue_rows (%d) must be >= max_batch (%d)"
                 % (self.max_queue_rows, self.max_batch))
         self.default_timeout_s = max(0.0, float(timeout_ms)) / 1e3
-        self._mon = monitor
         self._extra_summary = extra_summary
         # per-row shape every request must match (so one client cannot
         # poison a coalesced batch for the others); None = adopt the
@@ -125,7 +123,7 @@ class DynamicBatcher:
         # leaf lock for the cross-thread stats (collector, dispatcher
         # and submit all mutate them; += on a dict slot is not atomic)
         self._stats = threading.Lock()
-        self._emit_broken = False
+        self._safe_emit = SafeEmitter(monitor, "cxxnet_tpu serve")
         self._lat = LatencyHistogram()   # request latencies, always on
         self.counters: Dict[str, int] = {
             "requests": 0, "rows": 0, "batches": 0, "batch_rows": 0,
@@ -315,17 +313,9 @@ class DynamicBatcher:
     def _emit(self, kind: str, **fields) -> None:
         """Emit a serve record, never letting a sink failure (full
         disk, closed file) escape — a telemetry error must not kill a
-        worker thread and hang every waiting client."""
-        if self._mon is None or not self._mon.enabled:
-            return
-        try:
-            self._mon.emit(kind, **fields)
-        except Exception as e:
-            if not self._emit_broken:
-                self._emit_broken = True
-                print("cxxnet_tpu serve: telemetry emit failed "
-                      "(serving continues without records): %s" % e,
-                      file=sys.stderr)
+        worker thread and hang every waiting client. SafeEmitter owns
+        the warn-once latch (shared with the fleet frontend)."""
+        self._safe_emit(kind, **fields)
 
     def _emit_request(self, status: str, req: _Request,
                       queue_ms: float, latency_ms: float = 0.0) -> None:

@@ -121,7 +121,7 @@ class InferenceEngine:
         batch N computes. Rows are cast to float32 — the dtype warmup
         compiled — so no input dtype can trigger a steady-state
         compile."""
-        rows = np.asarray(rows)
+        rows = np.asarray(rows)  # cxxlint: disable=CXL003 -- host staging: request rows arrive as host numpy/json, never device values
         if rows.dtype != np.float32:
             rows = rows.astype(np.float32)
         n = rows.shape[0]
@@ -159,7 +159,16 @@ class InferenceEngine:
                 self.counters["compile_events"] += 1
             vals = t._call_pred(staged.data, staged.mask, (),
                                 staged.nodes)
-            out = np.asarray(vals[0])[:staged.nvalid]
+        # the result materialization is the expensive part of dispatch
+        # (wait for device compute + D2H copy) and needs no shared
+        # state: it must happen OUTSIDE the lock, or every concurrent
+        # dispatcher/library caller convoys behind one device round
+        # trip. _call_pred above only *issues* the async dispatch.
+        out = np.asarray(vals[0])[:staged.nvalid]  # cxxlint: disable=CXL003 -- boundary D2H: the client consumes host rows; runs lock-free
+        # success counters AFTER materialization: a device error
+        # surfaces at the D2H copy, and a failed dispatch must not
+        # count served rows (the batcher accounts the error separately)
+        with self._lock:
             self.counters["dispatches"] += 1
             self.counters["rows"] += staged.nvalid
             self.counters["pad_rows"] += staged.bucket - staged.nvalid

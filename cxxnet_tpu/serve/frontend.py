@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..monitor import SafeEmitter
 from .batcher import (ServeBusyError, ServeClosedError,
                       ServeTimeoutError)
 from .quota import QuotaManager, TenantQuotaError
@@ -286,6 +287,8 @@ class FleetServer:
         self._closing = False
         self._closed = False
         self._stats = threading.Lock()
+        self._safe_emit = SafeEmitter(monitor,
+                                      "cxxnet_tpu serve frontend")
         self.counters: Dict[str, int] = {
             name: 0 for name in STATUS_NAMES.values()}
         self.counters["requests"] = 0
@@ -418,12 +421,9 @@ class FleetServer:
     # -- telemetry / accounting -------------------------------------------
 
     def _emit(self, kind: str, **fields) -> None:
-        if self._mon is None or not self._mon.enabled:
-            return
-        try:
-            self._mon.emit(kind, **fields)
-        except Exception:
-            pass            # telemetry failure must not fail requests
+        # telemetry failure must not fail requests; SafeEmitter owns
+        # the warn-once latch (shared with DynamicBatcher)
+        self._safe_emit(kind, **fields)
 
     def _record(self, protocol: str, status: str, model: str,
                 tenant: str, rows: int, t0: float) -> None:

@@ -93,6 +93,13 @@ class SnapshotWatcher:
         self._mon = monitor
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # serializes the scan->build->flip->drain sequence AND guards
+        # the public counters: check_once runs on the poll thread but
+        # is also a public entry point (tests, the CLI's synchronous
+        # mode), and two overlapping calls that both see the same new
+        # snapshot would shadow-build twice and drain the session the
+        # first call just installed
+        self._lock = threading.Lock()
         self.swaps = 0
         self.failed_builds = 0
 
@@ -102,62 +109,70 @@ class SnapshotWatcher:
         """One poll: swap if a newer verified snapshot exists. Returns
         the ``hot_swap`` record fields on a swap, None otherwise.
         Never raises — a failed shadow build warns and leaves the
-        current engine serving."""
-        counter, path = latest_verified(self.model_dir)
-        if counter is None:
-            return None
-        try:
-            current = self.router.resolve(self.model_id)
-        except KeyError:
-            return None
-        if counter <= current.counter:
-            return None
-        t0 = time.monotonic()
-        try:
-            # shadow build + bucket warmup, off the request path: the
-            # router still serves the old engine while this compiles
-            session = self.builder(path)
-        except Exception as e:
-            self.failed_builds += 1
-            self._warn("hot_swap_build_failed:%s" % path,
-                       "hot-swap of model %r to %s failed to build "
-                       "(%s); keeping the current engine"
-                       % (self.model_id, path, e))
-            return None
-        try:
-            old = self.router.swap(self.model_id, session, counter,
-                                   path)
-        except Exception as e:
-            # router refused (closed mid-build, entry gone): the
-            # shadow engine must not leak its dispatcher threads
-            session.close(drain=False)
-            self._warn("hot_swap_flip_failed:%s" % path,
-                       "hot-swap of model %r to %s could not flip "
-                       "(%s); shadow engine discarded"
-                       % (self.model_id, path, e))
-            return None
-        # drain AFTER the flip: new traffic is already landing on the
-        # shadow engine, old traffic finishes on the retiring one
-        old_summary = old.session.close(drain=True)
-        self.swaps += 1
-        rec = {
-            "model": self.model_id,
-            "old_counter": old.counter,
-            "new_counter": counter,
-            "path": path,
-            "warmup_programs": int(
-                getattr(session, "warmup_programs", 0)),
-            "old_requests": int(old_summary.get("requests", 0)),
-            "old_compile_events": int(
-                old_summary.get("compile_events", 0)),
-            "wall_ms": (time.monotonic() - t0) * 1e3,
-        }
-        if self._mon is not None and self._mon.enabled:
+        current engine serving. Serialized: a concurrent call blocks,
+        then sees the freshly swapped counter and does nothing."""
+        with self._lock:
+            counter, path = latest_verified(self.model_dir)
+            if counter is None:
+                return None
             try:
-                self._mon.emit("hot_swap", **rec)
-            except Exception:
-                pass                     # telemetry must not kill swaps
-        return rec
+                current = self.router.resolve(self.model_id)
+            except KeyError:
+                return None
+            if counter <= current.counter:
+                return None
+            t0 = time.monotonic()
+            try:
+                # shadow build + bucket warmup, off the request path:
+                # the router still serves the old engine while this
+                # compiles
+                session = self.builder(path)
+            except Exception as e:
+                self.failed_builds += 1
+                self._warn("hot_swap_build_failed:%s" % path,
+                           "hot-swap of model %r to %s failed to build "
+                           "(%s); keeping the current engine"
+                           % (self.model_id, path, e))
+                return None
+            try:
+                old = self.router.swap(self.model_id, session, counter,
+                                       path)
+            except Exception as e:
+                # router refused (closed mid-build, entry gone): the
+                # shadow engine must not leak its dispatcher threads
+                session.close(drain=False)
+                self._warn("hot_swap_flip_failed:%s" % path,
+                           "hot-swap of model %r to %s could not flip "
+                           "(%s); shadow engine discarded"
+                           % (self.model_id, path, e))
+                return None
+            # drain AFTER the flip: new traffic is already landing on
+            # the shadow engine, old traffic finishes on the retiring
+            # one
+            old_summary = old.session.close(drain=True)
+            self.swaps += 1
+            rec = {
+                "model": self.model_id,
+                "old_counter": old.counter,
+                "new_counter": counter,
+                "path": path,
+                "warmup_programs": int(
+                    getattr(session, "warmup_programs", 0)),
+                "old_requests": int(old_summary.get("requests", 0)),
+                "old_compile_events": int(
+                    old_summary.get("compile_events", 0)),
+                "wall_ms": (time.monotonic() - t0) * 1e3,
+            }
+            if self._mon is not None and self._mon.enabled:
+                try:
+                    self._mon.emit("hot_swap", **rec)
+                except Exception:
+                    # telemetry must not kill swaps; the sink-broken
+                    # case warns exactly once instead of passing silently
+                    self._warn("hot_swap_emit_failed",
+                               "hot_swap record for model %r could "
+                               "not be emitted" % self.model_id)
+            return rec
 
     def _warn(self, code: str, message: str) -> None:
         if self._mon is not None:

@@ -1,0 +1,6 @@
+class Thing:
+    def set_param(self, name, val):
+        if name == "documented_key":
+            self.a = int(val)
+        if name == "mystery_key":
+            self.b = int(val)

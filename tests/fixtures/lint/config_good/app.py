@@ -1,0 +1,6 @@
+class Thing:
+    def set_param(self, name, val):
+        if name == "documented_key":
+            self.a = int(val)
+        if name in ("other_key", "other_key_alias"):
+            self.b = int(val)

@@ -1,0 +1,22 @@
+"""Positive CXL003: host syncs reachable from a hot-path root,
+including one inside a lock."""
+import threading
+import numpy as np
+
+
+class NetTrainer:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def update(self, batch):
+        return self._fetch(batch)
+
+    def update_many(self, batches):
+        with self._lock:
+            return np.asarray(batches)
+
+    def _fetch(self, x):
+        return np.asarray(x)
+
+    def offpath(self, x):
+        return np.asarray(x)   # not reachable from any root

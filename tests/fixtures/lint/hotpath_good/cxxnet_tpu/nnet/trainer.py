@@ -1,0 +1,14 @@
+"""Negative CXL003: the hot path keeps values on device; host work
+happens off-path."""
+import numpy as np
+
+
+class NetTrainer:
+    def update(self, batch):
+        return self._dispatch(batch)
+
+    def _dispatch(self, x):
+        return x
+
+    def offpath_metrics(self, x):
+        return np.asarray(x)

@@ -1,0 +1,18 @@
+"""Positive CXL002: counter written on the poll thread, no lock."""
+import threading
+
+
+class Watcher:
+    def __init__(self):
+        self.count = 0
+        self._t = None
+
+    def start(self):
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        self.poll()
+
+    def poll(self):
+        self.count += 1

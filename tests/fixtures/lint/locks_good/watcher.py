@@ -1,0 +1,20 @@
+"""Negative CXL002: same shape, writes under the declared lock."""
+import threading
+
+
+class Watcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._t = None
+
+    def start(self):
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        self.poll()
+
+    def poll(self):
+        with self._lock:
+            self.count += 1

@@ -1,0 +1,7 @@
+"""Positive CXL001: program construction outside the registry."""
+import jax
+
+
+def sneaky_compile(fn, x):
+    stepped = jax.jit(fn)            # jit outside the registry
+    return stepped.lower(x).compile()

@@ -1,0 +1,14 @@
+"""Negative CXL001: jit/lower inside allowlisted builders; zero-arg
+str.lower() is not a program build."""
+import jax
+
+
+class NetTrainer:
+    def _build_steps(self):
+        self._step = jax.jit(lambda x: x)
+
+    def precompile(self, x):
+        return self._step.lower(x).compile()
+
+    def normalize(self, uri):
+        return uri.lower()

@@ -1,0 +1,3 @@
+def run(mon):
+    mon.emit("good_kind", field=1)
+    mon.emit("mystery_kind", field=2)

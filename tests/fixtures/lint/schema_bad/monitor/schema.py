@@ -1,0 +1,4 @@
+REQUIRED = {
+    "good_kind": ("field",),
+    "orphan_kind": ("field",),
+}

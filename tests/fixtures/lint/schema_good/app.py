@@ -1,0 +1,10 @@
+def run(mon):
+    mon.emit("good_kind", field=1)
+
+
+class Wrapped:
+    def _emit(self, kind, **fields):
+        pass
+
+    def go(self):
+        self._emit("good_kind", field=3)

@@ -1,0 +1,3 @@
+REQUIRED = {
+    "good_kind": ("field",),
+}

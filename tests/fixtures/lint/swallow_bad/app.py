@@ -1,0 +1,9 @@
+def risky():
+    try:
+        return open("/nope").read()
+    except Exception:
+        pass
+    try:
+        return 1 / 0
+    except:
+        pass

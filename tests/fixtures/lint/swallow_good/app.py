@@ -1,0 +1,12 @@
+import sys
+
+
+def risky():
+    try:
+        return open("/nope").read()
+    except Exception as e:
+        sys.stderr.write("failed: %s\n" % e)
+    try:
+        return 1 / 0
+    except ZeroDivisionError:
+        pass  # cxxlint: disable=CXL006 -- the zero case is the sentinel; callers handle None

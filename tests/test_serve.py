@@ -5,7 +5,6 @@ frozen-engine parity with the trainer pred path, the threaded CPU smoke
 guard over every emitted record kind."""
 
 import os
-import re
 import threading
 import time
 
@@ -425,26 +424,19 @@ def test_wrapper_predict_buckets_varying_batch_sizes():
 
 
 def test_every_emitted_record_kind_has_a_validator():
-    """Grep-driven: every literal event name passed to Monitor.emit
-    anywhere in the tree must have a REQUIRED entry in monitor/schema.py
-    — a new record kind cannot ship unvalidated."""
+    """AST-driven (cxxlint CXL004): every emit()/_emit() literal kind
+    has a REQUIRED validator and every validator has an emitter. This
+    replaces the old grep guard, whose ``\\bemit\\(`` pattern could not
+    see the serve layer's ``self._emit("serve_request", ...)`` wrapper
+    emitters (``_`` is a word character) — the AST pass covers both
+    and reports file:line on drift."""
+    from cxxnet_tpu.lint import run_lint
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    pat = re.compile(r"\bemit\(\s*[\"']([a-z_]+)[\"']")
-    emitted = {}
-    for base in ("cxxnet_tpu", "tools"):
-        for dirpath, _, files in os.walk(os.path.join(root, base)):
-            for fn in files:
-                if not fn.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fn)
-                with open(path) as f:
-                    src = f.read()
-                for m in pat.finditer(src):
-                    emitted.setdefault(m.group(1), path)
-    assert emitted, "grep found no emit sites — pattern rotted"
-    missing = {k: v for k, v in emitted.items() if k not in REQUIRED}
-    assert not missing, \
-        "record kinds emitted without a schema validator: %r" % missing
+    res = run_lint([os.path.join(root, "cxxnet_tpu"),
+                    os.path.join(root, "tools")],
+                   select=["CXL004"])
+    assert res.findings == [], "\n".join(f.render()
+                                         for f in res.findings)
     # and the serve records specifically are part of the contract,
     # including the fleet layer's protocol/quota/hot-swap kinds
     for kind in ("serve_request", "serve_batch", "serve_summary",
