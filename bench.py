@@ -82,7 +82,8 @@ def load_compare_record(path):
         old = prev["models"]
     else:
         old = {"alexnet": {k: prev[k]
-                           for k in ("value", "spread", "suspect")
+                           for k in ("value", "spread", "suspect",
+                                     "dtype")
                            if k in prev}}
     for m, v in old.items():
         ov = v.get("value") if isinstance(v, dict) else v
@@ -107,13 +108,13 @@ def compare_models(old, new, floor=1.2):
     def parts(v):
         if isinstance(v, dict):
             return (v.get("value"), v.get("spread", 1.0),
-                    bool(v.get("suspect")))
-        return float(v), 1.0, False
+                    bool(v.get("suspect")), v.get("dtype"))
+        return float(v), 1.0, False, None
 
     out = {}
     for m in sorted(set(old) & set(new)):
-        ov, ospread, osus = parts(old[m])
-        nv, nspread, nsus = parts(new[m])
+        ov, ospread, osus, odt = parts(old[m])
+        nv, nspread, nsus, ndt = parts(new[m])
         tol = max(ospread, nspread, floor)
         if osus or nsus:
             verdict = "suspect"
@@ -125,7 +126,25 @@ def compare_models(old, new, floor=1.2):
             verdict = "ok"
         out[m] = {"old": round(ov, 1), "new": round(nv, 1),
                   "ratio": round(nv / ov, 3), "tolerance": round(tol, 3),
-                  "verdict": verdict}
+                  "verdict": verdict,
+                  # dtype annotation: pre-dtype records read "unknown"
+                  # (they are comparable by convention — the sweep ran
+                  # bf16 long before it was tagged)
+                  "old_dtype": odt or "unknown",
+                  "new_dtype": ndt or "unknown"}
+    return out
+
+
+def dtype_mismatches(old, new_dtype):
+    """Models whose prior record carries a compute dtype DIFFERENT from
+    the dtype this sweep will measure — cross-dtype img/s comparisons
+    are refused (exit 2) unless --allow-dtype-mismatch. Untagged old
+    records (pre-dtype rounds) compare freely."""
+    out = []
+    for m, v in sorted(old.items()):
+        odt = v.get("dtype") if isinstance(v, dict) else None
+        if odt and odt != new_dtype:
+            out.append((m, odt))
     return out
 
 
@@ -239,6 +258,10 @@ def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
         "zero_recompiles": not any(compiled_in_window),
         "flops_per_img": flops_img,
         "layout": layout_rec,
+        # dtype-tagged capture: --compare refuses to diff records
+        # measured in different compute dtypes (img/s across dtypes is
+        # not a regression signal)
+        "dtype": dtype,
     }
     if peak_tflops > 0 and flops_img > 0:
         out["mfu"] = round(ips * flops_img / (peak_tflops * 1e12), 4)
@@ -417,6 +440,15 @@ def main():
                     help="gradient/cotangent dtype (f32 master weights "
                          "either way); bf16 is the bench default — "
                          "half the cotangent HBM/ICI bytes")
+    ap.add_argument("--dtype", choices=["float32", "bfloat16"],
+                    default="bfloat16",
+                    help="compute dtype of the measured step; every "
+                         "record is dtype-tagged and --compare refuses "
+                         "cross-dtype diffs")
+    ap.add_argument("--allow-dtype-mismatch", action="store_true",
+                    help="compare img/s across records measured in "
+                         "different compute dtypes anyway (the rows "
+                         "stay dtype-annotated)")
     ap.add_argument("--peak-tflops", type=float, default=0.0,
                     help="chip peak TFLOP/s for the compute dtype; "
                          "when set, each model's record carries "
@@ -464,6 +496,7 @@ def main():
         model = args.model
         steps = args.steps if args.steps is not None else 200
         cap = measure(steps=steps, batch=args.batch, model=model,
+                      dtype=args.dtype,
                       grad_dtype=args.grad_dtype, extra=extra_cfg,
                       peak_tflops=args.peak_tflops)
         # 'AlexNet' spelling keeps the canonical BENCH metric name
@@ -480,6 +513,7 @@ def main():
             "suspect": cap["suspect"],
             "zero_recompiles": cap["zero_recompiles"],
             "layout": cap["layout"],
+            "dtype": cap["dtype"],
         }
         if "mfu" in cap:
             rec["mfu"] = cap["mfu"]
@@ -499,11 +533,22 @@ def main():
             old = load_compare_record(args.compare)
         except ValueError as e:
             ap.error(str(e))
+        # refuse cross-dtype comparisons BEFORE the minutes-long sweep:
+        # img/s measured in different compute dtypes is not a
+        # regression signal (exit 2 — a usage error, like a corrupt
+        # record)
+        mism = dtype_mismatches(old, args.dtype)
+        if mism and not args.allow_dtype_mismatch:
+            ap.error(
+                "cannot compare across dtypes: %s (this sweep measures "
+                "%s); pass --allow-dtype-mismatch to diff anyway"
+                % (", ".join("%s is %s" % mv for mv in mism),
+                   args.dtype))
     import gc
     models = {}
     for m in sorted(MODELS):
         steps = args.steps if args.steps is not None else 200
-        models[m] = measure(steps=steps, model=m,
+        models[m] = measure(steps=steps, model=m, dtype=args.dtype,
                             grad_dtype=args.grad_dtype, extra=extra_cfg,
                             peak_tflops=args.peak_tflops)
         gc.collect()                     # free HBM before the next model
@@ -514,6 +559,7 @@ def main():
         "unit": "images/sec/chip",
         "vs_baseline": round(head["value"] / BASELINE_IMAGES_PER_SEC, 3),
         "suspect": any(c["suspect"] for c in models.values()),
+        "dtype": args.dtype,
         "models": models,
     }
     # input-pipeline telemetry rides in every BENCH record from this

@@ -97,6 +97,11 @@ class LayerParam:
     # route relu_max_pooling through the fused Pallas kernel where
     # applicable (stride-1 VALID square max pools that fit VMEM)
     pallas_pool: int = 0
+    # run the conv's per-channel epilogue (bn_fold_eval scale/shift +
+    # relu, and the quantized path's dequant) as ONE Pallas pass
+    # (pallas_kernels.conv_epilogue) instead of folding the scale into
+    # the weights — same math, reassociation-level rounding only
+    conv_pallas_epilogue: int = 0
 
     def set_param(self, name: str, val: str) -> None:
         if name == "init_sigma":
@@ -152,6 +157,8 @@ class LayerParam:
             self.bn_fold_affine = int(val)
         if name == "pallas_pool":
             self.pallas_pool = int(val)
+        if name == "conv_pallas_epilogue":
+            self.conv_pallas_epilogue = int(val)
 
     def rand_init_weight(self, key: jax.Array, shape: Tuple[int, ...],
                          in_num: int, out_num: int) -> jnp.ndarray:

@@ -67,7 +67,19 @@ class FullConnectLayer(Layer):
     def forward(self, params, state, inputs, is_train, rng):
         x = inputs[0]
         w = params["wmat"]
-        bf16 = self.param.compute_dtype == "bfloat16"
+        # serve_dtype quantization spec (nnet/quantize.attach): eval
+        # forwards only — the int8/fp8 matmul contracts the quantized
+        # operands and the per-out-channel dequant rides the epilogue
+        q = None if is_train else getattr(self, "_quant", None)
+        if q is not None and q.is_affine:
+            y = jnp.dot(q.quantize_x(x), q.quantize_w(w),
+                        preferred_element_type=q.acc_dtype())
+            y = y.astype(jnp.float32) * q.dequant_vec()
+            if self.param.no_bias == 0:
+                y = y + params["bias"]
+            return [y], state
+        bf16 = (self.param.compute_dtype == "bfloat16"
+                or (q is not None and q.dtype == "bfloat16"))
         if bf16:
             x = x.astype(jnp.bfloat16)
             w = w.astype(jnp.bfloat16)
@@ -365,6 +377,15 @@ class ConcatLayer(Layer):
             if self.dim != 3:
                 raise ValueError("ch_concat on matrix nodes is unsupported")
             return [jnp.concatenate(inputs, axis=1)], state
+        # Inception tower tail fusion (net-level pool_concat_pallas
+        # pass, nnet/net.py): the pool-branch input arrives UN-pooled
+        # and one Pallas pass reduces its window while writing every
+        # branch into its channel segment
+        fused = getattr(self, "_fused_pool", None)
+        if fused is not None and self.dim == 1:
+            from .pallas_kernels import pool_concat
+            pos, k, mode = fused
+            return [pool_concat(tuple(inputs), pos, k, mode)], state
         axis = {1: 3, 2: 1, 3: 2}[self.dim]   # NCHW dim -> NHWC axis
         return [jnp.concatenate(inputs, axis=axis)], state
 

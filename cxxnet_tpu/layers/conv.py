@@ -147,13 +147,23 @@ class ConvolutionLayer(Layer):
         p = self.param
         x = inputs[0]
         w = params["wmat"]
+        # serve_dtype quantization spec (nnet/quantize.attach); only
+        # the eval/pred forward ever consults it
+        q = None if is_train else getattr(self, "_quant", None)
+        quant = q is not None and q.is_affine
         # BN epilogue folded into the conv (eval/pred path): the net's
         # bn_fold_eval pass injects the per-out-channel _fold_scale /
         # _fold_shift (from the BN's running stats) and the downstream
         # BN runs as identity — w*scale folds into the (small) weight
-        # tensor, deleting the per-layer elementwise pass entirely
+        # tensor, deleting the per-layer elementwise pass entirely.
+        # With conv_pallas_epilogue the factor instead applies to the
+        # conv OUTPUT inside the fused scale+shift(+relu) Pallas pass
+        # (reassociation-level rounding only, same as the weight fold)
         fold_scale = params.get("_fold_scale")
-        if fold_scale is not None:
+        out_pad = getattr(self, "_out_pad", 0)
+        fold_in_epilogue = (fold_scale is not None and not quant
+                            and p.conv_pallas_epilogue and not out_pad)
+        if fold_scale is not None and not fold_in_epilogue:
             w = w * fold_scale          # f32, per out channel (HWIO)
         # channel-alignment annotations (nnet/layout.py): zero weight
         # rows absorb a padded input's dead channels, zero weight
@@ -169,16 +179,86 @@ class ConvolutionLayer(Layer):
                         w.shape[:2] + (padc, w.shape[3]), w.dtype))
                 off += valid
             w = jnp.concatenate(parts, axis=2)
-        out_pad = getattr(self, "_out_pad", 0)
         if out_pad:
             w = jnp.pad(w, ((0, 0), (0, 0), (0, 0), (0, out_pad)))
-        bf16 = p.compute_dtype == "bfloat16"
-        if bf16:
-            # both operands bf16, output bf16 (the conv VJP requires
-            # matching operand/cotangent dtypes; MXU still accumulates
-            # in f32 internally)
-            x = x.astype(jnp.bfloat16)
-            w = w.astype(jnp.bfloat16)
+        bf16 = (p.compute_dtype == "bfloat16"
+                or (q is not None and q.dtype == "bfloat16"))
+        if quant:
+            # int8/fp8 contraction: symmetric per-tensor activation /
+            # per-out-channel weight quantization on device, the MXU
+            # contracts the low dtype (int32 or f32 accumulation), and
+            # the per-channel dequant folds into the epilogue below —
+            # channel-alignment layouts never reach here (quantize
+            # .quantizable excludes annotated layers)
+            y = jax.lax.conv_general_dilated(
+                q.quantize_x(x), q.quantize_w(w),
+                window_strides=(p.stride, p.stride),
+                padding=[(p.pad_y, p.pad_y), (p.pad_x, p.pad_x)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=p.num_group,
+                preferred_element_type=q.acc_dtype())
+        else:
+            if bf16:
+                # both operands bf16, output bf16 (the conv VJP requires
+                # matching operand/cotangent dtypes; MXU still
+                # accumulates in f32 internally)
+                x = x.astype(jnp.bfloat16)
+                w = w.astype(jnp.bfloat16)
+            y = self._float_conv(x, w, bf16)
+        # bf16 outputs stay bf16: activations ride low-precision through
+        # relu/pool/lrn to the loss (which upcasts) — per-layer
+        # f32 round-trips were a wall of convert fusions in the profile
+        if fold_scale is not None:
+            b = params["_fold_shift"]
+            if p.no_bias == 0:
+                b = b + params["bias"] * fold_scale
+        elif p.no_bias == 0:
+            b = params["bias"]
+        else:
+            b = None
+        relu = fold_scale is not None and "_fold_relu" in params
+        ep_scale = q.dequant_vec() if quant \
+            else (fold_scale if fold_in_epilogue else None)
+        if ep_scale is not None:
+            # one fused per-channel scale+shift(+relu) pass: the
+            # quantized dequant or the output-side BN fold — through
+            # the Pallas kernel when configured and applicable
+            shift = b if b is not None else jnp.zeros_like(ep_scale)
+            # bf16 covers BOTH the training compute_dtype knob and
+            # serve_dtype=bfloat16 — a bf16-served graph must emit bf16
+            # from the fused epilogue or the ladder's halved activation
+            # bytes are lost mid-graph
+            out_dtype = jnp.bfloat16 if bf16 else jnp.float32
+            from .pallas_kernels import (conv_epilogue,
+                                         conv_epilogue_applicable)
+            if p.conv_pallas_epilogue \
+                    and conv_epilogue_applicable(y.shape):
+                y = conv_epilogue(y, ep_scale.astype(jnp.float32),
+                                  shift.astype(jnp.float32), relu,
+                                  out_dtype)
+            else:
+                yf = y.astype(jnp.float32) * ep_scale + shift
+                if relu:
+                    yf = jax.nn.relu(yf)
+                y = yf.astype(out_dtype)
+        else:
+            if b is not None:
+                if out_pad:               # padded channels stay zero
+                    b = jnp.pad(b, ((0, out_pad),))
+                y = y + b.astype(y.dtype)
+            if relu:
+                y = jax.nn.relu(y)
+        # named for the remat=conv policy (trainer._wrap_loss_fn): under
+        # save_only_these_names("conv_out") the backward keeps conv
+        # outputs and recomputes BN/activation/pool between them;
+        # identity when no checkpoint policy is active
+        y = checkpoint_name(y, "conv_out")
+        return [y], state
+
+    def _float_conv(self, x, w, bf16):
+        """The three float conv lowerings (pointwise-as-matmul,
+        space-to-depth entry rewrite, general NHWC/HWIO conv)."""
+        p = self.param
         if (p.conv_1x1_matmul and p.kernel_height == 1
                 and p.kernel_width == 1 and p.stride == 1
                 and p.num_group == 1 and p.pad_y == 0 and p.pad_x == 0):
@@ -204,29 +284,7 @@ class ConvolutionLayer(Layer):
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 feature_group_count=p.num_group,
                 preferred_element_type=None if bf16 else jnp.float32)
-        # bf16 outputs stay bf16: activations ride low-precision through
-        # relu/pool/lrn to the loss (which upcasts) — per-layer
-        # f32 round-trips were a wall of convert fusions in the profile
-        if fold_scale is not None:
-            b = params["_fold_shift"]
-            if p.no_bias == 0:
-                b = b + params["bias"] * fold_scale
-        elif p.no_bias == 0:
-            b = params["bias"]
-        else:
-            b = None
-        if b is not None:
-            if out_pad:                   # padded channels stay zero
-                b = jnp.pad(b, ((0, out_pad),))
-            y = y + b.astype(y.dtype)
-        if fold_scale is not None and "_fold_relu" in params:
-            y = jax.nn.relu(y)
-        # named for the remat=conv policy (trainer._wrap_loss_fn): under
-        # save_only_these_names("conv_out") the backward keeps conv
-        # outputs and recomputes BN/activation/pool between them;
-        # identity when no checkpoint policy is active
-        y = checkpoint_name(y, "conv_out")
-        return [y], state
+        return y
 
 
 class PoolingLayer(Layer):

@@ -322,6 +322,224 @@ def _bn_apply_vjp_bwd(relu, res, dy):
 bn_apply.defvjp(_bn_apply_vjp_fwd, _bn_apply_vjp_bwd)
 
 
+# ------------------------------------------------ conv epilogue fusion
+
+def _conv_epilogue_kernel(relu: bool, out_dtype, x_ref, s_ref, t_ref,
+                          o_ref):
+    """One block: o = relu?(x * scale + shift) with the arithmetic in
+    f32 — the conv/quantized-conv epilogue. Unlike the BN kernel the
+    input may be an int32 accumulator (native int8 conv) whose
+    per-channel dequant IS the scale, so x upcasts to f32 first and
+    the output dtype is explicit."""
+    x = x_ref[...].astype(jnp.float32)
+    y = x * s_ref[...] + t_ref[...]
+    if relu:
+        y = jnp.maximum(y, 0)
+    o_ref[...] = y.astype(out_dtype)
+
+
+def _conv_epilogue_call(x: jnp.ndarray, scale: jnp.ndarray,
+                        shift: jnp.ndarray, relu: bool,
+                        out_dtype) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+
+    mat = x.ndim == 2
+    x4 = x[:, None, None, :] if mat else x
+    b, h, w, c = x4.shape
+    rows = _bn_rows(h, w, c, max(x4.dtype.itemsize, 4))
+    y = pl.pallas_call(
+        partial(_conv_epilogue_kernel, relu, out_dtype),
+        grid=(b, h // rows),
+        in_specs=[
+            pl.BlockSpec((1, rows, w, c), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, c), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, c), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, rows, w, c),
+                               lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, c), out_dtype),
+        interpret=_interpret(),
+    )(x4, scale.astype(jnp.float32)[None, :],
+      shift.astype(jnp.float32)[None, :])
+    return y[:, 0, 0, :] if mat else y
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def conv_epilogue(x: jnp.ndarray, scale: jnp.ndarray,
+                  shift: jnp.ndarray, relu: bool,
+                  out_dtype=jnp.float32) -> jnp.ndarray:
+    """Fused conv epilogue: ``relu?(x * scale + shift)`` per out
+    channel as ONE Pallas pass (NHWC or matrix nodes). Two callers:
+    the eval ``bn_fold_eval`` path (scale = the BN running-stats
+    factor, applied to the conv output instead of pre-folded into the
+    weights — reassociation-level rounding only) and the quantized
+    path, where ``x`` is the raw int8-conv accumulator and ``scale``
+    carries the per-channel dequant (x_scale * w_scale) folded with
+    the BN factor. Differentiable in the float case for training
+    reuse; the int32 accumulator only ever flows on the eval path."""
+    return _conv_epilogue_call(x, scale, shift, relu, out_dtype)
+
+
+def _conv_epilogue_vjp_fwd(x, scale, shift, relu, out_dtype):
+    y = _conv_epilogue_call(x, scale, shift, relu, out_dtype)
+    return y, (x, scale, y)
+
+
+def _conv_epilogue_vjp_bwd(relu, out_dtype, res, dy):
+    x, scale, y = res
+    dym = jnp.where(y > 0, dy, jnp.zeros_like(dy)) if relu else dy
+    dx = _conv_epilogue_call(dym, scale, jnp.zeros_like(scale), False,
+                             x.dtype)
+    axes = tuple(range(x.ndim - 1))
+    dscale = jnp.sum((dym.astype(jnp.float32)
+                      * x.astype(jnp.float32)), axis=axes)
+    dshift = jnp.sum(dym.astype(jnp.float32), axis=axes)
+    return (dx, dscale.astype(scale.dtype), dshift.astype(scale.dtype))
+
+
+conv_epilogue.defvjp(_conv_epilogue_vjp_fwd, _conv_epilogue_vjp_bwd)
+
+
+def conv_epilogue_applicable(shape) -> bool:
+    """Config gate for the fused epilogue: NHWC or matrix nodes whose
+    single (1, rows, w, c) block tiles VMEM (guaranteed by the _bn_rows
+    chunking for any row that fits — true for every conv feature map)."""
+    return len(shape) in (2, 4) and shape[-1] > 0
+
+
+# -------------------------------------- fused pool+concat (Inception)
+
+def _pool_concat_kernel(k: int, mode: str, pool_pos: int, segs, *refs):
+    """One batch item: write every branch into its channel segment of
+    the concat output; the ``pool_pos`` input arrives pre-padded (zero
+    pad, the reference base-pad semantics) and its k*k stride-1 window
+    reduction happens in-register on the way into its segment — the
+    pooled intermediate is never materialized in HBM."""
+    o_ref = refs[-1]
+    for idx, (x_ref, (off, c)) in enumerate(zip(refs[:-1], segs)):
+        x = x_ref[0]
+        if idx != pool_pos:
+            o_ref[0, :, :, off:off + c] = x
+            continue
+        oh = x.shape[0] - k + 1
+        ow = x.shape[1] - k + 1
+        y = x[0:oh, 0:ow, :]
+        for di in range(k):
+            for dj in range(k):
+                if di == 0 and dj == 0:
+                    continue
+                sl = x[di:di + oh, dj:dj + ow, :]
+                y = jnp.maximum(y, sl) if mode == "max" else y + sl
+        if mode == "avg":
+            y = y * (1.0 / (k * k))
+        o_ref[0, :, :, off:off + c] = y
+
+
+def _pool_concat_call(branches, pool_pos: int, k: int,
+                      mode: str) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+
+    p = k // 2
+    xs = list(branches)
+    b, h, w, _ = xs[0].shape
+    dtype = xs[0].dtype
+    # zero pad OUTSIDE the kernel (XLA fuses it into the transfer);
+    # the kernel then runs a plain VALID stride-1 window
+    xs[pool_pos] = jnp.pad(xs[pool_pos].astype(dtype),
+                           ((0, 0), (p, p), (p, p), (0, 0)))
+    segs, off = [], 0
+    for x in branches:
+        segs.append((off, x.shape[-1]))
+        off += x.shape[-1]
+    in_specs = [pl.BlockSpec((1,) + x.shape[1:],
+                             lambda i: (i, 0, 0, 0)) for x in xs]
+    return pl.pallas_call(
+        partial(_pool_concat_kernel, k, mode, pool_pos, tuple(segs)),
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, h, w, off), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, off), dtype),
+        interpret=_interpret(),
+    )(*[x.astype(dtype) for x in xs])
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def pool_concat(branches, pool_pos: int, k: int,
+                mode: str) -> jnp.ndarray:
+    """Fused Inception tower tail: ``ch_concat(branches)`` where the
+    branch at ``pool_pos`` is the UN-pooled input of a k*k stride-1
+    SAME (pad = k//2) max/avg pool — one Pallas pass writes every
+    branch into its channel segment and reduces the pool window on the
+    way, deleting both the pooled intermediate and the separate concat
+    copy (the remaining device-step gap in the Inception modules after
+    channel alignment). Zero-pad semantics match the reference pooling
+    layer exactly (mshadow ``pad()`` is a zero pad; avg divides by
+    k*k unconditionally). Differentiable: the backward credits every
+    input equal to its window max (reference unpool tie semantics) /
+    redistributes uniformly for avg."""
+    return _pool_concat_call(branches, pool_pos, k, mode)
+
+
+def _pool_concat_vjp_fwd(branches, pool_pos, k, mode):
+    out = _pool_concat_call(branches, pool_pos, k, mode)
+    segs, off = [], 0
+    for x in branches:
+        segs.append((off, x.shape[-1]))
+        off += x.shape[-1]
+    o, c = segs[pool_pos]
+    y_pool = out[..., o:o + c] if mode == "max" else None
+    return out, (tuple(branches), y_pool)
+
+
+def _pool_concat_vjp_bwd(pool_pos, k, mode, res, dy):
+    branches, y_pool = res
+    p = k // 2
+    grads, off = [], 0
+    for i, x in enumerate(branches):
+        c = x.shape[-1]
+        seg = dy[..., off:off + c]
+        off += c
+        if i != pool_pos:
+            grads.append(seg.astype(x.dtype))
+            continue
+        h, w = x.shape[1], x.shape[2]
+        dyf = seg.astype(jnp.float32)
+        accp = jnp.zeros((x.shape[0], h + 2 * p, w + 2 * p, c),
+                         jnp.float32)
+        if mode == "max":
+            xp = jnp.pad(x.astype(jnp.float32),
+                         ((0, 0), (p, p), (p, p), (0, 0)))
+            yf = y_pool.astype(jnp.float32)
+        for di in range(k):
+            for dj in range(k):
+                if mode == "max":
+                    # every input equal to its window max receives the
+                    # window's cotangent (reference unpool ties)
+                    contrib = jnp.where(
+                        xp[:, di:di + h, dj:dj + w, :] == yf, dyf, 0.0)
+                else:
+                    contrib = dyf * (1.0 / (k * k))
+                accp = accp.at[:, di:di + h, dj:dj + w, :].add(contrib)
+        grads.append(accp[:, p:p + h, p:p + w, :].astype(x.dtype))
+    return (tuple(grads),)
+
+
+pool_concat.defvjp(_pool_concat_vjp_fwd, _pool_concat_vjp_bwd)
+
+
+def pool_concat_applicable(h: int, w: int, total_ch: int, k: int,
+                           itemsize: int) -> bool:
+    """Fusion gate: the whole (H, W, Ctotal) item (inputs + output +
+    the pool halo) must sit comfortably inside scoped VMEM — true for
+    every Inception tower map (<= 28x28 x ~1k ch), false for stem-sized
+    maps, which keep the unfused path."""
+    if k <= 1 or k % 2 == 0:
+        return False
+    per_item = (h + 2 * (k // 2)) * (w + 2 * (k // 2)) \
+        * _pad_to(total_ch, 128) * itemsize
+    return 3 * per_item <= 6 * 1024 * 1024
+
+
 class PallasFullConnectLayer(FullConnectLayer):
     """fullc with the matmul lowered through the Pallas kernel
     (config name ``pallas_fullc``); numerically identical to ``fullc``

@@ -24,6 +24,12 @@ PROGRAM_BUILDERS = {
         "NetTrainer._compile_programs",
     ),
     "cxxnet_tpu/layers/pallas_kernels.py": ("<module>",),
+    # the calibration amax program (one jitted forward computing every
+    # quantizable layer's activation range per batch) — offline
+    # task=quantize path, never dispatched while serving
+    "cxxnet_tpu/nnet/quantize.py": (
+        "Calibrator._build_amax_program",
+    ),
 }
 
 # -- CXL003: hot-path roots -----------------------------------------------

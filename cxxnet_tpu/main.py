@@ -42,8 +42,11 @@ _MODEL_RE = re.compile(r"^(\d{4})\.model\.npz$")
 # wrapper scripts treat it as "re-queue me" (doc/checkpointing.md)
 EXIT_PREEMPTED = 75
 
-# tasks that read data through the pred iterator (or its fallback)
-_PRED_TASKS = ("pred", "extract_feature", "extract", "pred_raw", "serve")
+# tasks that read data through the pred iterator (or its fallback);
+# quantize rides here too — calibration wants the deterministic eval
+# transform, not the shuffled/augmented training stream
+_PRED_TASKS = ("pred", "extract_feature", "extract", "pred_raw", "serve",
+               "quantize")
 
 # randomized-pipeline knobs neutralized when a pred-like task falls
 # back to the train data block: evaluation order must be the file
@@ -101,6 +104,13 @@ class LearnTask:
         self.checkpoint_fsync = 1
         self.keep_snapshots = 0          # 0 = keep every snapshot
         self.stream_retry = 0            # remote read retries (opt-in)
+        # post-training quantization (task = quantize,
+        # doc/perf_profile.md "Low-precision inference"): target dtype,
+        # calibration stream length, the f32 parity gate, output path
+        self.quantize_dtype = "int8"
+        self.quantize_batches = 8
+        self.quantize_parity_eps = 0.05
+        self.quantize_out = ""
         # observability (doc/observability.md); a null monitor until
         # run() builds the configured one, so task methods are safe to
         # call directly in tests
@@ -171,6 +181,14 @@ class LearnTask:
             self.keep_snapshots = int(val)
         if name == "stream_retry":
             self.stream_retry = int(val)
+        if name == "quantize_dtype":
+            self.quantize_dtype = val
+        if name == "quantize_batches":
+            self.quantize_batches = int(val)
+        if name == "quantize_parity_eps":
+            self.quantize_parity_eps = float(val)
+        if name == "quantize_out":
+            self.quantize_out = val
 
     # -- model files -----------------------------------------------------
 
@@ -334,6 +352,10 @@ class LearnTask:
             if self.task == "serve":
                 assert self.model_in, "task serve requires model_in"
                 return self._task_serve(cfg, pred_iter or itr_train)
+
+            if self.task == "quantize":
+                assert self.model_in, "task quantize requires model_in"
+                return self._task_quantize(cfg, pred_iter or itr_train)
 
             trainer = NetTrainer(cfg)
             if self.task in ("train", "finetune"):
@@ -676,6 +698,111 @@ class LearnTask:
             mon.emit("task_end", task="serve", requests=agg["ok"],
                      rows=summary["rows"])
         return 0
+
+    def _task_quantize(self, cfg, itr) -> int:
+        """Post-training calibration (doc/perf_profile.md
+        "Low-precision inference"): stream the iterator through the
+        frozen eval net collecting per-channel activation/weight
+        ranges, parity-gate the quantized graph against the f32 eval
+        outputs over the same batches, and commit a digest-verified
+        snapshot whose ``quant/`` arrays carry the ranges — the
+        artifact ``serve_dtype = int8|fp8`` loads."""
+        assert itr is not None, "quantize requires an iterator block"
+        assert world_size() == 1, "task=quantize must run single-process"
+        from .io.data import DataBatch
+        from .nnet.checkpoint import write_snapshot
+        from .nnet.quantize import Calibrator, normalize_serve_dtype
+        mon = self._mon
+        t_start = time.time()
+        qdtype = normalize_serve_dtype(self.quantize_dtype)
+        if qdtype not in ("int8", "fp8"):
+            raise ValueError(
+                "quantize_dtype must be int8 or fp8, got %r"
+                % self.quantize_dtype)
+        if mon.enabled:
+            mon.emit("run_start",
+                     **run_metadata("quantize", self._cfg_stream))
+        # calibration runs the f32 graph whatever the config's
+        # serve_dtype says (a deployment conf carries serve_dtype=int8
+        # for the serve replicas; the override appends last, so it wins)
+        trainer = NetTrainer(list(cfg) + [("serve_dtype", "float32")])
+        trainer.load_model(self.model_in)
+        top = (trainer.graph.num_nodes - 1,)
+        calib = Calibrator(trainer)
+        if not calib.targets:
+            raise ValueError(
+                "task=quantize: this net has no quantizable layers "
+                "(conv/fullc owning their params, no channel-alignment "
+                "annotations) — nothing to calibrate")
+        batches, refs = [], []
+        for batch in itr:
+            # private copies: iterator ring buffers recycle their arrays
+            nb = DataBatch(data=np.array(batch.data),
+                           label=np.array(batch.label),
+                           num_batch_padd=batch.num_batch_padd)
+            nvalid = nb.batch_size - nb.num_batch_padd
+            (val,) = trainer._call_pred(
+                trainer._put_batch_array(nb.data),
+                trainer._put_mask(nb), (), top)
+            refs.append(np.array(trainer._local_rows(val)[:nvalid]))
+            calib.observe(nb)
+            batches.append(nb)
+            if len(batches) >= self.quantize_batches:
+                break
+        assert batches, "quantize: iterator produced no batches"
+        tables = calib.finish()
+        qmeta = {"dtype": qdtype, "batches": len(batches),
+                 "source": self.model_in,
+                 "bn_fold_eval": trainer.net._bn_fold_eval,
+                 "parity_eps": self.quantize_parity_eps}
+        # activate the quantized graph on THIS trainer (fresh programs)
+        # and measure parity against the stored f32 outputs
+        trainer.set_quantization(tables, qmeta, dtype=qdtype)
+        max_abs = mean_sum = agree = nrow = nelt = 0
+        for nb, ref in zip(batches, refs):
+            nvalid = nb.batch_size - nb.num_batch_padd
+            (val,) = trainer._call_pred(
+                trainer._put_batch_array(nb.data),
+                trainer._put_mask(nb), (), top)
+            got = trainer._local_rows(val)[:nvalid]
+            diff = np.abs(got.astype(np.float64) - ref)
+            max_abs = max(max_abs, float(diff.max()))
+            mean_sum += float(diff.sum())
+            nelt += diff.size
+            agree += int(np.sum(trainer.rows_to_prediction(got)
+                                == trainer.rows_to_prediction(ref)))
+            nrow += nvalid
+        mean_abs = mean_sum / max(nelt, 1)
+        agree_rate = agree / max(nrow, 1)
+        rep = trainer.quant_report
+        out = self.quantize_out or re.sub(
+            r"\.npz$", "", self.model_in) + ".%s.npz" % qdtype
+        ok = mean_abs <= self.quantize_parity_eps
+        if ok:
+            arrays, meta = trainer.gather_snapshot()
+            write_snapshot(out, arrays, meta,
+                           fsync=bool(self.checkpoint_fsync))
+        wall = time.time() - t_start
+        if mon.enabled:
+            mon.emit("quantize", dtype=rep.get("dtype", qdtype),
+                     batches=len(batches), layers=rep.get("layers", 0),
+                     fallback_layers=rep.get("fallback_layers", 0),
+                     parity_max_abs=max_abs, parity_mean_abs=mean_abs,
+                     agree_rate=agree_rate, out=out if ok else "",
+                     wall_ms=wall * 1e3)
+        mon.line(
+            "quantize[%s]: %d layers (%d fallback) over %d batches, "
+            "parity mean|Δ| %.2g max|Δ| %.2g agree %.3f — %s"
+            % (rep.get("dtype", qdtype), rep.get("layers", 0),
+               rep.get("fallback_layers", 0), len(batches), mean_abs,
+               max_abs, agree_rate,
+               ("wrote %s" % out) if ok else
+               "PARITY GATE FAILED (eps %g), no snapshot written"
+               % self.quantize_parity_eps))
+        if mon.enabled:
+            mon.emit("task_end", task="quantize", outfile=out if ok
+                     else "", rows=nrow)
+        return 0 if ok else 1
 
     def _task_serve_fleet(self, cfg) -> int:
         """Fleet serving (doc/serving.md "Fleet serving"): N routed

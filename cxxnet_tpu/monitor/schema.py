@@ -83,6 +83,14 @@ REQUIRED: Dict[str, tuple] = {
     "resume": ("source", "counter", "scanned", "quarantined"),
     "preempt": ("signal", "round", "exit_code"),
     "stream_retry": ("uri", "what", "attempts"),
+    # low-precision inference (doc/perf_profile.md "Low-precision
+    # inference"): the task=quantize calibration+parity rollup, and the
+    # per-load activation record a trainer emits when serve_dtype turns
+    # a calibrated snapshot into a quantized graph
+    "quantize": ("dtype", "batches", "layers", "fallback_layers",
+                 "parity_max_abs", "parity_mean_abs", "agree_rate",
+                 "out", "wall_ms"),
+    "quantized_model": ("dtype", "layers", "fallback_layers", "native"),
 }
 
 _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",
@@ -95,7 +103,7 @@ _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",
 
 # ratio fields must sit in [0, 1]
 _RATIO_KEYS = ("buffer_reuse_rate", "h2d_overlap_ratio", "fill_rate",
-               "pad_fraction")
+               "pad_fraction", "agree_rate")
 
 
 def validate_record(rec: Dict[str, Any]) -> List[str]:

@@ -52,6 +52,10 @@ from .net import FuncNet
 
 _RE_METRIC = re.compile(r"^metric(?:\[([^\]]*)\])?$")
 
+# the one non-f32 float staging dtype _ship passes through unconverted
+# (bf16-warmed serve ladders; numpy spells it via ml_dtypes through jnp)
+_BF16 = np.dtype(jnp.bfloat16)
+
 
 def _tree_add(a, b):
     return jax.tree_util.tree_map(jnp.add, a, b)
@@ -95,6 +99,15 @@ class NetTrainer:
         self.precompile_dtype = "float32"  # input dtype precompile()
         #                                  lowers for (uint8 pipelines
         #                                  set precompile_dtype=uint8)
+        self.serve_dtype = "float32"     # eval/pred/serve compute
+        #                                  dtype: float32 | bfloat16 |
+        #                                  int8 | fp8 — int8/fp8 need a
+        #                                  calibrated snapshot
+        #                                  (task=quantize); training
+        #                                  dispatch never consults it
+        self.quant_tables = {}           # quant/<layer> range arrays
+        self.quant_meta = {}             # __meta__["quantized"]
+        self.quant_report = {"active": False}
         self.input_layout = "none"       # rowmajor: pin the batch
         #                                  input's device layout with
         #                                  channels minor (lane dim) so
@@ -174,6 +187,9 @@ class NetTrainer:
                     raise ValueError(
                         "input_layout must be none or rowmajor")
                 self.input_layout = val
+            if name == "serve_dtype":
+                from .quantize import normalize_serve_dtype
+                self.serve_dtype = normalize_serve_dtype(val)
             if name in ("shard_optimizer", "update_on_server"):
                 # update_on_server=1 meant "optimizer state lives off the
                 # workers" (nnet_ps_server.cpp); here it means "optimizer
@@ -240,9 +256,35 @@ class NetTrainer:
             ni = self.net.node_index_by_name(node) if node else top
             self._metric_nodes.append(ni)
         self._label_slices = self.graph.label_slices()
+        # serve_dtype activation BEFORE the programs build: the specs
+        # live on the layer objects and must be pinned before any
+        # forward traces (nnet/quantize.attach)
+        self._attach_quant()
         self._build_steps()
         self._put_all()
         self._initialized = True
+        self._emit_model_records()
+
+    def _attach_quant(self) -> None:
+        from .quantize import attach
+        self.quant_report = attach(self)
+
+    def set_quantization(self, tables, meta,
+                         dtype: Optional[str] = None) -> None:
+        """Install calibration range tables (and optionally switch the
+        serve dtype), then rebuild the dispatch programs so the next
+        eval/pred traces the quantized graph. The tables ride in every
+        subsequent snapshot as digest-covered ``quant/`` arrays
+        (task=quantize is the canonical caller)."""
+        assert self._initialized, "call init_model/load_model first"
+        self.quant_tables = dict(tables)
+        self.quant_meta = dict(meta)
+        if dtype is not None:
+            from .quantize import normalize_serve_dtype
+            self.serve_dtype = normalize_serve_dtype(dtype)
+        self._attach_quant()
+        self._build_steps()
+        self._put_all()
         self._emit_model_records()
 
     def _put_all(self) -> None:
@@ -948,8 +990,10 @@ class NetTrainer:
         else float32; under multi-process dp each rank contributes its
         local shard of the global batch (config batch_size is GLOBAL,
         split across ranks like the reference splits across PS
-        workers)."""
-        if arr.dtype != np.uint8:
+        workers). bf16 rows also ship raw — a bf16-warmed serve ladder
+        staging through here must not silently up-cast (and recompile)
+        on the H2D path."""
+        if arr.dtype != np.uint8 and arr.dtype != _BF16:
             arr = np.asarray(arr, np.float32)  # cxxlint: disable=CXL003 -- host-side cast before the H2D ship; input is host numpy
         if jax.process_count() > 1:
             return jax.make_array_from_process_local_data(sharding, arr)
@@ -1065,7 +1109,14 @@ class NetTrainer:
                        input_layout=self.input_layout,
                        bn_fuse_relu=len(net._identity_layers),
                        bn_fold_eval_pairs=len(net._fold_pairs),
+                       pool_concat_fused=len(net._pool_concat),
                        **net.layout_summary)
+        if self.quant_report.get("active"):
+            r = self.quant_report
+            self._mon.emit("quantized_model", dtype=r["dtype"],
+                           layers=r["layers"],
+                           fallback_layers=r["fallback_layers"],
+                           native=r["native"])
 
     def _mon_on(self) -> bool:
         return self._mon is not None and self._mon.enabled
@@ -1492,11 +1543,19 @@ class NetTrainer:
                 for tag, st in tags.items():
                     for k, v in st.items():
                         arrays["opt/%s/%s/%s" % (lk, tag, k)] = fetch(v)
+        # calibration range tables ride as ordinary arrays so the
+        # content digest covers them (a quantized snapshot is a
+        # first-class verified artifact; nnet/quantize.py)
+        for lkey, tab in self.quant_tables.items():
+            for field, v in tab.items():
+                arrays["quant/%s/%s" % (lkey, field)] = np.asarray(v)
         meta = {
             "update_counter": self.update_counter,
             "structure": self.graph.to_dict(),
             "cfg": self.cfg,
         }
+        if self.quant_meta:
+            meta["quantized"] = dict(self.quant_meta)
         return arrays, meta
 
     def save_model(self, path: str) -> None:
@@ -1540,6 +1599,11 @@ class NetTrainer:
                     st[kk] = jnp.asarray(blob[k])
         self.params, self.net_state = params, net_state
         self.update_counter = int(meta.get("update_counter", 0))
+        # calibration ranges (task=quantize snapshots) load before
+        # _post_init so serve_dtype activation sees them
+        from .quantize import tables_from_blob
+        self.quant_tables = tables_from_blob(blob)
+        self.quant_meta = dict(meta.get("quantized", {}))
         self._post_init()
         # restore optimizer state when the snapshot carries it
         if any(k.startswith("opt/") for k in blob):

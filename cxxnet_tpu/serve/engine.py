@@ -31,6 +31,15 @@ from .bucketing import (bucket_ladder, pad_to_bucket, pick_bucket,
                         reachable_variants)
 
 
+def input_dtype_for(serve_dtype: str):
+    """The staging dtype a ladder warms for a ``serve_dtype``: bf16
+    ladders warm and stage bf16 (half the H2D bytes); int8/fp8 graphs
+    quantize on device, so their input stays f32. One definition shared
+    by :func:`build_engine` and ``tools/serve_bench.py``."""
+    import jax.numpy as jnp
+    return jnp.bfloat16 if serve_dtype == "bfloat16" else np.float32
+
+
 class StagedBatch:
     """A micro-batch whose H2D transfer has been issued: device-resident
     data + mask, the valid-row count, and the node set to fetch."""
@@ -60,10 +69,15 @@ class InferenceEngine:
     """
 
     def __init__(self, trainer, buckets: Optional[Sequence[int]] = None,
-                 node: str = "", monitor=None):
+                 node: str = "", monitor=None,
+                 input_dtype=np.float32):
         assert trainer._initialized, \
             "InferenceEngine needs an initialized trainer"
         self.trainer = trainer
+        # the dtype the bucket ladder warms (and therefore the ONLY
+        # dtype stage() may ship): a bf16-warmed ladder staging f32
+        # would recompile-hazard every dispatch
+        self.input_dtype = np.dtype(input_dtype)
         mesh_axes = dict(trainer.mesh.shape)
         align = int(mesh_axes.get("data", 1))
         if buckets is None:
@@ -94,12 +108,13 @@ class InferenceEngine:
         first-request latency pays no lazy-init cost. Resets the
         compile counter: events counted afterwards are real steady-
         state compiles — the number a healthy server keeps at zero."""
-        compiled = self.trainer.precompile_pred(self.buckets, self.nodes)
+        compiled = self.trainer.precompile_pred(self.buckets, self.nodes,
+                                                dtype=self.input_dtype)
         if warm_run:
             inst = self._inst_shape()
             for _, rows in reachable_variants(self.buckets):
                 self.dispatch(self.stage(
-                    np.zeros((rows,) + inst, np.float32)))
+                    np.zeros((rows,) + inst, self.input_dtype)))
         with self._lock:
             self.counters["compile_events"] = 0
             self.counters["aot_hits"] = 0
@@ -118,12 +133,14 @@ class InferenceEngine:
         """Pad ``rows`` (internal layout: NHWC / (n, features), any
         dtype) to their bucket and issue the H2D transfer. Cheap host
         work + an async device_put — safe to run for batch N+1 while
-        batch N computes. Rows are cast to float32 — the dtype warmup
-        compiled — so no input dtype can trigger a steady-state
-        compile."""
+        batch N computes. Rows are cast to the engine's warmed
+        ``input_dtype`` (f32 by default, bf16 under a bf16-warmed
+        ladder) — so no caller dtype can trigger a steady-state
+        compile, and a low-precision ladder never silently up-casts on
+        the H2D path."""
         rows = np.asarray(rows)  # cxxlint: disable=CXL003 -- host staging: request rows arrive as host numpy/json, never device values
-        if rows.dtype != np.float32:
-            rows = rows.astype(np.float32)
+        if rows.dtype != self.input_dtype:
+            rows = rows.astype(self.input_dtype)
         n = rows.shape[0]
         bucket = pick_bucket(n, self.buckets)
         if bucket is None:
@@ -211,16 +228,21 @@ def build_engine(cfg, model_path: str,
     """
     import jax
 
+    from ..nnet.quantize import normalize_serve_dtype
     from ..nnet.trainer import NetTrainer
     from ..parallel import make_mesh
     from .bucketing import mesh_align, parse_buckets
     cfg = list(cfg)
+    serve_dtype = "float32"
     if not max_batch:
         for k, v in cfg:
             if k == "batch_size":
                 max_batch = int(v)
         if not max_batch:
             raise ValueError("serve needs batch_size (or serve_max_batch)")
+    for k, v in cfg:
+        if k == "serve_dtype":
+            serve_dtype = normalize_serve_dtype(v)
     spec = buckets if isinstance(buckets, str) else ""
     if isinstance(buckets, str) or buckets is None:
         buckets = parse_buckets(spec, max_batch)
@@ -230,4 +252,5 @@ def build_engine(cfg, model_path: str,
     if monitor is not None:
         trainer.set_monitor(monitor)
     return InferenceEngine(trainer, buckets=buckets, node=node,
-                           monitor=monitor)
+                           monitor=monitor,
+                           input_dtype=input_dtype_for(serve_dtype))

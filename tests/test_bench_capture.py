@@ -129,3 +129,41 @@ def test_bench_cli_emits_capture_fields():
     assert len(rec["dt"]) in (2, 3)
     assert rec["spread"] >= 1.0
     assert isinstance(rec["suspect"], bool)
+
+
+def test_compare_rows_carry_dtype_annotation():
+    out = bench.compare_models(
+        {"m": {"value": 1000.0, "dtype": "bfloat16"}},
+        {"m": {"value": 990.0, "dtype": "int8"}})
+    assert out["m"]["old_dtype"] == "bfloat16"
+    assert out["m"]["new_dtype"] == "int8"
+    # untagged (pre-dtype) records annotate as unknown, not a crash
+    out = bench.compare_models({"m": 1000.0},
+                               {"m": {"value": 990.0}})
+    assert out["m"]["old_dtype"] == "unknown"
+    assert out["m"]["new_dtype"] == "unknown"
+
+
+def test_dtype_mismatches_helper():
+    old = {"a": {"value": 1.0, "dtype": "float32"},
+           "b": {"value": 1.0, "dtype": "bfloat16"},
+           "c": {"value": 1.0}}                  # untagged: comparable
+    assert bench.dtype_mismatches(old, "bfloat16") == [("a", "float32")]
+    assert bench.dtype_mismatches(old, "float32") == [("b", "bfloat16")]
+
+
+def test_compare_refuses_cross_dtype_without_flag(tmp_path):
+    """--compare against a record measured in another compute dtype
+    exits 2 BEFORE the sweep unless --allow-dtype-mismatch is passed
+    (img/s across dtypes is not a regression signal)."""
+    f = tmp_path / "BENCH_f32.json"
+    f.write_text(json.dumps({
+        "models": {"alexnet": {"value": 9000.0, "dtype": "float32"}}}))
+    p = subprocess.run(
+        [sys.executable, "bench.py", "--compare", str(f)],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert p.returncode == 2, (p.returncode, p.stderr[-500:])
+    assert "cannot compare across dtypes" in p.stderr
+    assert "--allow-dtype-mismatch" in p.stderr

@@ -70,6 +70,7 @@ def build_session(args, monitor):
         ("serve_buckets", args.buckets),
         ("serve_max_delay_ms", str(args.max_delay_ms)),
         ("serve_queue_rows", str(args.queue_rows)),
+        ("serve_dtype", args.serve_dtype),
     ]
     if args.conf:
         cfg = parse_config_file(args.conf) + serve_pairs
@@ -85,9 +86,10 @@ def build_session(args, monitor):
     trainer.init_model()
     trainer.set_monitor(monitor)
     from cxxnet_tpu.serve.bucketing import parse_buckets
+    from cxxnet_tpu.serve.engine import input_dtype_for
     engine = InferenceEngine(
         trainer, buckets=parse_buckets(args.buckets, 32),
-        monitor=monitor)
+        monitor=monitor, input_dtype=input_dtype_for(args.serve_dtype))
     return ServeSession(cfg, engine=engine, monitor=monitor)
 
 
@@ -108,7 +110,7 @@ def sweep_point(args, clients, monitor, sink):
     errs = validate_records(sink.records)
     assert not errs, "schema-invalid serve telemetry: %s" % errs[:5]
     batches = [r for r in sink.records if r["event"] == "serve_batch"]
-    return {
+    pt = {
         "clients": clients,
         "requests_ok": agg["ok"],
         "requests_busy": agg["busy"],
@@ -124,6 +126,26 @@ def sweep_point(args, clients, monitor, sink):
         "compile_events": summary["compile_events"],
         "serve_batch_records": len(batches),
     }
+    mfu = serve_mfu(sink.records, agg["rows_per_sec"],
+                    args.peak_tflops)
+    if mfu is not None:
+        pt["mfu"] = mfu
+    return pt
+
+
+def serve_mfu(records, rows_per_sec, peak_tflops):
+    """Serve-side MFU from telemetry: the analytic forward FLOPs ride
+    in the ``model_info`` record the engine's trainer emits, so serve
+    and train MFU columns come from the same denominator (bench.py's
+    --peak-tflops plumbing, doc/perf_profile.md "MFU bookkeeping").
+    Eval is forward-only: flops_per_example, not the 3x train count."""
+    if peak_tflops <= 0:
+        return None
+    flops = next((r["flops_per_example"] for r in records
+                  if r["event"] == "model_info"), 0.0)
+    if flops <= 0:
+        return None
+    return round(rows_per_sec * flops / (peak_tflops * 1e12), 6)
 
 
 def parse_tenants(spec):
@@ -171,6 +193,7 @@ def run_multi_tenant(args, monitor, sink):
         ("serve_buckets", args.buckets),
         ("serve_max_delay_ms", str(args.max_delay_ms)),
         ("serve_queue_rows", str(args.queue_rows)),
+        ("serve_dtype", args.serve_dtype),
         ("serve_http_port", "-1"),
         ("serve_binary_port", "0"),
         ("serve_swap_poll_s", "0"),
@@ -286,11 +309,13 @@ def run_multi_tenant(args, monitor, sink):
     zero_recompiles = all(
         m.get("compile_events", 0) == 0
         for m in summary["models"].values())
+    total_rps = sum(r["rows_per_sec"] for r in rows_out)
     rec = {
         "name": "serve_bench",
         "mode": "multi_tenant",
         "t": time.time(),
         "model": args.conf or "synthetic_mlp_256_64_10",
+        "dtype": args.serve_dtype,
         "buckets": args.buckets,
         "max_delay_ms": args.max_delay_ms,
         "requests_per_client": args.requests,
@@ -302,6 +327,9 @@ def run_multi_tenant(args, monitor, sink):
         "zero_recompiles": zero_recompiles,
         "quota": summary["quota"],
     }
+    mfu = serve_mfu(sink.records, total_rps, args.peak_tflops)
+    if mfu is not None:
+        rec["mfu"] = mfu
     return rec, slo_ok, zero_recompiles
 
 
@@ -328,7 +356,21 @@ def main(argv=None) -> int:
     ap.add_argument("--slo-p99-ms", type=float, default=0.0,
                     help="per-tenant ok-request p99 SLO; breach "
                          "exits 3 (0 = no assertion)")
+    ap.add_argument("--serve-dtype", default="float32",
+                    choices=["float32", "bfloat16", "int8", "fp8"],
+                    help="serve_dtype for the engine (int8/fp8 need a "
+                         "task=quantize calibrated --model-in); the "
+                         "record is dtype-tagged")
+    ap.add_argument("--peak-tflops", type=float, default=0.0,
+                    help="chip peak TFLOP/s for the serve dtype; when "
+                         "set, every sweep point carries an MFU column "
+                         "from the model_info analytic FLOPs — "
+                         "comparable with bench.py's train MFU")
     args = ap.parse_args(argv)
+    if args.serve_dtype in ("int8", "fp8") and not args.conf:
+        ap.error("--serve-dtype %s needs a task=quantize calibrated "
+                 "snapshot: pass --conf/--model-in (the synthetic MLP "
+                 "has no calibration ranges)" % args.serve_dtype)
 
     from cxxnet_tpu.monitor import MemorySink, Monitor
     import jax
@@ -365,6 +407,7 @@ def main(argv=None) -> int:
         "t": time.time(),
         "platform": jax.default_backend(),
         "model": args.conf or "synthetic_mlp_256_64_10",
+        "dtype": args.serve_dtype,
         "buckets": args.buckets,
         "max_delay_ms": args.max_delay_ms,
         "requests_per_client": args.requests,
