@@ -256,6 +256,10 @@ def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
         "spread": round(agreeing_spread(dts), 3),
         "suspect": suspect,
         "zero_recompiles": not any(compiled_in_window),
+        # program-registry accounting: how many AOT executables the
+        # precompile window built (the capture path compiles exactly
+        # one — the run_steps program)
+        "precompile_programs": t.precompile_programs,
         "flops_per_img": flops_img,
         "layout": layout_rec,
         # dtype-tagged capture: --compare refuses to diff records

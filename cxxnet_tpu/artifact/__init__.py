@@ -1,0 +1,27 @@
+"""Sealed model artifacts: program registry + export/boot bundles.
+
+Two halves (doc/artifacts.md):
+
+- :mod:`~cxxnet_tpu.artifact.registry` — the :class:`ProgramRegistry`
+  every AOT executable in the system lives in, plus the single-sourced
+  dispatch-signature scheme (``pred_sig`` / ``update_sig`` /
+  ``update_many_sig`` / ``run_steps_sig``). The trainer owns one;
+  serve/bench/pred consume it through the trainer.
+- :mod:`~cxxnet_tpu.artifact.bundle` — the sealed on-disk artifact:
+  verified snapshot + serialized executables + fingerprint + schema'd
+  manifest, committed two-phase. ``task = export`` writes one;
+  ``serve`` / ``serve_fleet`` / ``pred`` boot from one with near-zero
+  cold start when the fingerprint matches.
+
+The bundle module is imported lazily by consumers (it pulls in the
+checkpoint subsystem); import it explicitly as
+``from cxxnet_tpu.artifact import bundle``.
+"""
+
+from .registry import (ProgramRegistry, parse_key, pred_sig,
+                       run_steps_sig, update_many_sig, update_sig)
+
+__all__ = [
+    "ProgramRegistry", "parse_key", "pred_sig", "run_steps_sig",
+    "update_many_sig", "update_sig",
+]

@@ -1,0 +1,268 @@
+"""The program registry: ONE owner for every AOT executable.
+
+Before this module, the (signature, bucket, mask-variant, dtype,
+layout) key scheme and the lower+compile loop lived inside
+``NetTrainer`` (``precompile`` / ``precompile_pred`` /
+``_compile_programs``) and were *consumed* from four places — trainer
+precompile, serve engine warmup, bench, and ``_call_pred`` — each
+re-deriving dispatch signatures inline. The registry is the extraction
+of that state into one object:
+
+- **key scheme** — the module-level ``*_sig`` functions are the single
+  definition of every dispatch signature. The trainer builds its
+  precompile keys AND its per-dispatch lookup keys through them, so a
+  scheme change cannot strand one call site on a stale scheme (the
+  bug class PR 4's ``pred_sig`` unification closed for pred, now
+  closed for update/update_many/run_steps too).
+- **compile loop** — :meth:`ProgramRegistry.compile` is the one place
+  ``(key, lower-thunk)`` pairs become executables: failure fallback,
+  signature seeding and per-program compile telemetry cannot drift
+  between the training and serving warmup paths.
+- **serialization** — a compiled executable round-trips through
+  ``jax.experimental.serialize_executable`` into the sealed artifact
+  bundle (:mod:`cxxnet_tpu.artifact.bundle`), and
+  :meth:`ProgramRegistry.install_serialized` loads them back at boot:
+  a key satisfied from a bundle never re-lowers, and the per-key
+  hit/rebuild accounting feeds the ``artifact_load`` telemetry record
+  so the zero-compile cold-start claim is counted, not asserted.
+
+Keys are tuples of primitives (strings, ints, bools, nested tuples):
+``repr(key)`` is the bundle manifest's key encoding and
+``ast.literal_eval`` recovers it exactly.
+"""
+
+from __future__ import annotations
+
+import ast
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+# -- the dispatch-signature scheme ----------------------------------------
+#
+# Every function returns the signature WITHOUT the leading kind tag;
+# a full registry key is ("update",) + update_sig(...), etc. The
+# trainer's per-dispatch lookups and its precompile key construction
+# both call these — the single source the registry exists for.
+
+
+def pred_sig(shape, dtype, mask_is_none: bool, n_extra: int,
+             nodes_wanted) -> tuple:
+    """The eval/pred forward signature: (batch shape, input dtype,
+    mask variant, extra-input count, served node set)."""
+    return (tuple(shape), str(dtype), bool(mask_is_none), int(n_extra),
+            tuple(nodes_wanted))
+
+
+def update_sig(data_shape, dtype, label_shape, mask_is_none: bool,
+               n_extra: int, do_update: bool) -> tuple:
+    """The per-batch train-step signature (static apply flag baked)."""
+    return (tuple(data_shape), str(dtype), tuple(label_shape),
+            bool(mask_is_none), int(n_extra), bool(do_update))
+
+
+def update_many_sig(data_k_shape, dtype, labels_k_shape,
+                    mask_is_none: bool, n_extra: int, window: int,
+                    collect: bool) -> tuple:
+    """The K-batch window signature (leading axis = scan step)."""
+    return (tuple(data_k_shape), str(dtype), tuple(labels_k_shape),
+            bool(mask_is_none), int(n_extra), int(window),
+            bool(collect))
+
+
+def run_steps_sig(data_shape, dtype, label_shape, mask_is_none: bool,
+                  n_extra: int, n_steps: int) -> tuple:
+    """The resident-batch scan signature (bench/test_skipread mode)."""
+    return (tuple(data_shape), str(dtype), tuple(label_shape),
+            bool(mask_is_none), int(n_extra), int(n_steps))
+
+
+def parse_key(text: str) -> tuple:
+    """Recover a registry key from its ``repr`` (the bundle manifest
+    encoding). Keys are tuples of primitives, so ``literal_eval`` is
+    exact; anything else raises ValueError."""
+    key = ast.literal_eval(text)
+    if not isinstance(key, tuple) or not key \
+            or not isinstance(key[0], str):
+        raise ValueError("not a registry key: %r" % text)
+    return key
+
+
+class ProgramRegistry:
+    """Compiled-executable store keyed by (kind,) + signature.
+
+    Owned by one trainer; the serve engine and bench consume it
+    through the trainer. ``seen`` is the compile-event detection set
+    (a dispatch whose key is not in ``seen`` paid a compile) — it
+    deliberately survives :meth:`reset` the way the trainer's
+    signature set always did, so a program rebuild does not erase the
+    run's compile accounting.
+    """
+
+    def __init__(self):
+        self.aot: Dict[tuple, Any] = {}
+        self.seen: set = set()
+        # sealed-artifact accounting (install_serialized)
+        self.bundle_path = ""
+        self.fingerprint_match = True
+        self.art_hits = 0
+        self.art_rebuilds = 0
+        # keys whose executable was DESERIALIZED from a bundle: a
+        # Loaded executable does not re-serialize faithfully (the
+        # payload comes back without its compiled symbols), so
+        # re-export must copy these keys' original blobs from the
+        # source bundle instead of serializing the live object
+        self.installed: set = set()
+
+    # -- lookup ----------------------------------------------------------
+
+    def get(self, key: tuple):
+        """The executable for ``key``, or None (jit fallback)."""
+        return self.aot.get(key)
+
+    def __contains__(self, key: tuple) -> bool:
+        return key in self.aot
+
+    def __len__(self) -> int:
+        return len(self.aot)
+
+    def reset(self) -> None:
+        """Orphan every executable (a program rebuild: new graph, new
+        shardings). Bundle-installed programs go too — they were
+        compiled against the replaced graph."""
+        self.aot = {}
+        self.bundle_path = ""
+        self.fingerprint_match = True
+        self.art_hits = 0
+        self.art_rebuilds = 0
+        self.installed = set()
+
+    # -- the one compile loop --------------------------------------------
+
+    def compile(self, programs: Sequence[Tuple[tuple, Callable]],
+                warn_code: str, monitor=None) -> int:
+        """AOT-compile ``(key, lower-thunk)`` pairs, skipping keys
+        already present (including keys a bundle install satisfied —
+        that skip IS the near-zero cold start). A failed compile warns
+        once and leaves that key on the jit fallback path; per-program
+        telemetry rides on ``monitor`` when one is attached. Returns
+        the number of programs newly compiled."""
+        compiled = 0
+        for key, thunk in programs:
+            if key in self.aot:
+                continue
+            try:
+                t0 = time.perf_counter()
+                self.aot[key] = thunk().compile()
+            except Exception as e:
+                from ..monitor import warn_once
+                warn_once(warn_code,
+                          "precompile of %r failed (falling back to "
+                          "jit): %s" % (key[0], e))
+                continue
+            compiled += 1
+            # seed the signature set: the run's first dispatch of this
+            # signature is NOT a compile — it happened here, and the
+            # stream records it with its own wall time
+            self.seen.add(key)
+            if monitor is not None and monitor.enabled:
+                monitor.emit("compile", kind="precompile",
+                             wall_ms=(time.perf_counter() - t0) * 1e3,
+                             signature=repr(key))
+        return compiled
+
+    # -- sealed-artifact serialization -----------------------------------
+
+    def serialize_programs(self, monitor=None
+                           ) -> List[Tuple[tuple, bytes]]:
+        """Serialize every freshly COMPILED executable into portable
+        blobs (``jax.experimental.serialize_executable`` payload +
+        arg pytrees, pickled together), round-trip-checked: each blob
+        is
+        deserialized once right here, because a blob that only fails
+        at boot would silently degrade zero-compile to
+        rebuild-everything (observed with re-serialized *Loaded*
+        executables: the payload comes back without its compiled
+        symbols). Keys in ``installed`` are excluded — the exporter
+        copies their original bundle blobs byte-for-byte instead.
+        Unserializable executables are skipped with one warning — a
+        bundle with fewer programs still boots, it just re-lowers the
+        missing keys."""
+        from jax.experimental import serialize_executable as se
+        out: List[Tuple[tuple, bytes]] = []
+        for key in sorted(self.aot, key=repr):
+            if key in self.installed:
+                continue
+            try:
+                payload, in_tree, out_tree = se.serialize(self.aot[key])
+                blob = pickle.dumps((payload, in_tree, out_tree),
+                                    protocol=pickle.HIGHEST_PROTOCOL)
+                se.deserialize_and_load(*pickle.loads(blob))
+            except Exception as e:
+                _warn(monitor, "artifact_serialize_failed",
+                      "executable %r does not serialize round-trip "
+                      "(%s); the bundle ships without it and boot "
+                      "re-lowers that key" % (key[0], e))
+                continue
+            out.append((key, blob))
+        return out
+
+    def install_serialized(self, programs: Sequence[Tuple[tuple, bytes]],
+                           path: str, fingerprint_ok: bool,
+                           monitor=None) -> Dict[str, Any]:
+        """Deserialize bundle executables into the store.
+
+        With a matching runtime fingerprint every loadable program
+        becomes a resident executable (a *hit*: that key will never
+        lower or compile this boot). A mismatched fingerprint installs
+        NOTHING — one warning, and every key re-lowers on demand (a
+        *rebuild*). Per-blob deserialization failures also fall back
+        per-key. Returns the ``artifact_load`` record fields; honesty
+        rule: ``hits + rebuilds == len(programs)``, always.
+        """
+        t0 = time.perf_counter()
+        hits = rebuilds = 0
+        self.bundle_path = path
+        self.fingerprint_match = bool(fingerprint_ok)
+        if not fingerprint_ok:
+            rebuilds = len(programs)
+            _warn(monitor, "artifact_fingerprint_mismatch",
+                  "artifact bundle %s was sealed on a different "
+                  "platform/jaxlib/topology; its %d executable(s) are "
+                  "unusable here — every program re-lowers and "
+                  "recompiles (results are unaffected)"
+                  % (path, len(programs)))
+        else:
+            from jax.experimental import serialize_executable as se
+            for key, blob in programs:
+                try:
+                    payload, in_tree, out_tree = pickle.loads(blob)
+                    exe = se.deserialize_and_load(payload, in_tree,
+                                                  out_tree)
+                except Exception as e:
+                    rebuilds += 1
+                    _warn(monitor, "artifact_deserialize_failed",
+                          "bundle executable %r failed to load (%s); "
+                          "that key re-lowers and recompiles"
+                          % (key[0], e))
+                    continue
+                self.aot[key] = exe
+                # a bundle-installed program is not a compile event:
+                # the first dispatch of this signature runs a sealed
+                # executable
+                self.seen.add(key)
+                self.installed.add(key)
+                hits += 1
+        self.art_hits, self.art_rebuilds = hits, rebuilds
+        return {"path": path,
+                "fingerprint_match": bool(fingerprint_ok),
+                "hits": hits, "rebuilds": rebuilds,
+                "wall_ms": (time.perf_counter() - t0) * 1e3}
+
+
+def _warn(monitor, code: str, message: str) -> None:
+    if monitor is not None:
+        monitor.warn_once(code, message)
+    else:
+        from ..monitor import warn_once
+        warn_once(code, message)

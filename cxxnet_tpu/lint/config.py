@@ -23,6 +23,14 @@ PROGRAM_BUILDERS = {
         "NetTrainer.precompile_pred",
         "NetTrainer._compile_programs",
     ),
+    # the program registry (doc/artifacts.md): the one compile loop
+    # every (key, lower-thunk) pair goes through, and the sealed-
+    # artifact deserializer that installs bundle executables in place
+    # of compilation
+    "cxxnet_tpu/artifact/registry.py": (
+        "ProgramRegistry.compile",
+        "ProgramRegistry.install_serialized",
+    ),
     "cxxnet_tpu/layers/pallas_kernels.py": ("<module>",),
     # the calibration amax program (one jitted forward computing every
     # quantizable layer's activation range per batch) — offline

@@ -111,6 +111,10 @@ class LearnTask:
         self.quantize_batches = 8
         self.quantize_parity_eps = 0.05
         self.quantize_out = ""
+        # sealed artifact export (task = export, doc/artifacts.md):
+        # output bundle directory; "" derives NNNN.model.bundle beside
+        # model_in so a watched model_dir picks the bundle up
+        self.export_out = ""
         # observability (doc/observability.md); a null monitor until
         # run() builds the configured one, so task methods are safe to
         # call directly in tests
@@ -189,6 +193,8 @@ class LearnTask:
             self.quantize_parity_eps = float(val)
         if name == "quantize_out":
             self.quantize_out = val
+        if name == "export_out":
+            self.export_out = val
 
     # -- model files -----------------------------------------------------
 
@@ -318,6 +324,11 @@ class LearnTask:
                 # deployment config's train blocks may point at paths
                 # the serving host does not mount)
                 return self._task_serve_fleet(cfg)
+            if self.task == "export":
+                # sealing a snapshot into a bundle needs no data
+                # either — only the net config and the serve contract
+                assert self.model_in, "task export requires model_in"
+                return self._task_export(cfg)
             if (self.task in _PRED_TASKS and not self.test_io
                     and not any(b["kind"] == "pred" for b in blocks)):
                 # no 'pred =' block: these tasks fall back to the train
@@ -369,6 +380,9 @@ class LearnTask:
                 return self._task_train(trainer, itr_train, eval_iters)
 
             assert self.model_in, "task %s requires model_in" % self.task
+            # monitor before load: a bundle model_in emits its
+            # artifact_load accounting during load_model
+            trainer.set_monitor(self._mon)
             trainer.load_model(self.model_in)
             if self.task == "pred":
                 return self._task_predict(trainer, pred_iter or itr_train)
@@ -849,6 +863,42 @@ class LearnTask:
         if mon.enabled:
             mon.emit("task_end", task="serve_fleet",
                      requests=c["requests"], swaps=summary["swaps"])
+        return 0
+
+    def _task_export(self, cfg) -> int:
+        """Seal ``model_in`` into a deployable artifact bundle
+        (doc/artifacts.md): load the verified snapshot into a frozen
+        bucket-ladder engine, AOT-compile every (bucket, mask-variant)
+        executable the serve contract can dispatch, and commit
+        snapshot + serialized executables + fingerprint + manifest as
+        one two-phase bundle at ``export_out`` (default: the
+        ``NNNN.model.bundle`` sibling of ``model_in``). A serve
+        replica booting from the bundle on a matching runtime
+        deserializes instead of compiling — near-zero cold start."""
+        assert world_size() == 1, "task=export must run single-process"
+        from .artifact.bundle import default_bundle_path, export_bundle
+        from .serve import ServeConfig, build_engine
+        mon = self._mon
+        if mon.enabled:
+            mon.emit("run_start",
+                     **run_metadata("export", self._cfg_stream))
+        sc = ServeConfig(cfg)
+        engine = build_engine(cfg, self.model_in, buckets=sc.buckets,
+                              max_batch=sc.max_batch, node=sc.node,
+                              monitor=mon)
+        # warm_run off: export needs the executables, not the
+        # first-request latency of a live server
+        compiled = engine.warmup(warm_run=False)
+        out = self.export_out or default_bundle_path(self.model_in)
+        stats = export_bundle(engine, out, node=sc.node, monitor=mon)
+        if mon.enabled:
+            mon.emit("export", **stats)
+        mon.line("export: sealed %s -> %s (%d programs compiled, %d "
+                 "serialized, %d bytes)"
+                 % (self.model_in, out, compiled, stats["programs"],
+                    stats["bytes"]))
+        if mon.enabled:
+            mon.emit("task_end", task="export", outfile=out)
         return 0
 
     def _task_predict(self, trainer, itr) -> int:

@@ -91,6 +91,16 @@ REQUIRED: Dict[str, tuple] = {
                  "parity_max_abs", "parity_mean_abs", "agree_rate",
                  "out", "wall_ms"),
     "quantized_model": ("dtype", "layers", "fallback_layers", "native"),
+    # sealed model artifacts (doc/artifacts.md): the task=export
+    # rollup, and the honest per-boot accounting of a bundle load —
+    # hits (executables deserialized, never re-lowered) vs rebuilds
+    # (fingerprint mismatch / bad blob: those keys re-lower+compile
+    # on demand); hits + rebuilds always equals the bundle's program
+    # count
+    "export": ("out", "snapshot", "programs", "members", "bytes",
+               "wall_ms"),
+    "artifact_load": ("path", "fingerprint_match", "hits", "rebuilds",
+                      "wall_ms"),
 }
 
 _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",

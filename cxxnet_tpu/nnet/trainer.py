@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..artifact import registry as _areg
 from ..graph import NetGraph
 from ..io.data import DataBatch
 from ..parallel import (batch_sharding, make_mesh, opt_state_sharding,
@@ -130,13 +131,14 @@ class NetTrainer:
         self._round_t0 = None            # set by start_round
         self.last_round_examples_per_sec = 0.0   # of the closed round
         self._pending_data_wait = 0.0    # loop-measured iterator wait
-        self._seen_sigs = set()          # dispatch signatures (compile
-        #                                  / recompile detection)
         self.last_round_examples = 0     # set by end_round
         self.last_round_wall_s = 0.0
-        # AOT-compiled executables keyed by dispatch signature
-        # (precompile()); empty = every dispatch goes through jit
-        self._aot: Dict[tuple, Any] = {}
+        # the program registry: every AOT executable this trainer owns,
+        # keyed by (kind,) + dispatch signature, plus the compile-event
+        # signature set and the sealed-artifact hit/rebuild accounting
+        # (cxxnet_tpu.artifact.registry — serve/bench/pred consume it
+        # through this trainer). Empty = every dispatch goes through jit
+        self.programs = _areg.ProgramRegistry()
         self.precompile_wall_s = 0.0
         self.precompile_programs = 0
 
@@ -307,7 +309,7 @@ class NetTrainer:
 
     def _build_steps(self) -> None:
         mesh = self.mesh
-        self._aot = {}                   # rebuilt programs orphan any
+        self.programs.reset()            # rebuilt programs orphan any
         #                                  earlier AOT executables
         self._b_shard = batch_sharding(mesh)
         self._probe_input_layout()
@@ -622,25 +624,37 @@ class NetTrainer:
         dll, layout = self._layout_cls
         return layout(dll(major_to_minor=tuple(range(ndim))), sharding)
 
+    @property
+    def _aot(self) -> Dict[tuple, Any]:
+        """The registry's executable map — kept as a read surface for
+        the serve engine's aot-hit accounting and tests; mutation goes
+        through ``self.programs``."""
+        return self.programs.aot
+
+    @property
+    def _seen_sigs(self) -> set:
+        """Dispatch signatures seen (compile/recompile detection) —
+        registry-owned so precompile seeding and bundle installs share
+        one set with the dispatch-time accounting."""
+        return self.programs.seen
+
     def _call_step(self, kind, sig, jit_fn, args, **static_kw):
-        """Dispatch one program: the AOT executable when precompile
-        built this exact signature (static args baked in), the jit
-        function otherwise. One code path so a key-scheme change cannot
+        """Dispatch one program: the registry executable when this
+        exact signature was precompiled (or installed from a sealed
+        artifact — static args baked in either way), the jit function
+        otherwise. One code path so a key-scheme change cannot
         silently strand a dispatch site on jit fallback."""
-        aot = self._aot.get((kind,) + sig) if self._aot else None
+        aot = self.programs.get((kind,) + sig)
         if aot is not None:
             return aot(*args)
         return jit_fn(*args, **static_kw)
 
-    @staticmethod
-    def pred_sig(shape, dtype, mask_is_none: bool, n_extra: int,
-                 nodes_wanted) -> tuple:
-        """The pred dispatch signature (sans the leading "pred" kind).
-        The single definition shared by `_call_pred`, `precompile_pred`
-        and the serve engine's compile-event accounting — a key-scheme
-        change here cannot strand one of them on a stale scheme."""
-        return (tuple(shape), str(dtype), mask_is_none, int(n_extra),
-                tuple(nodes_wanted))
+    # the pred dispatch signature (sans the leading "pred" kind): the
+    # single definition — cxxnet_tpu.artifact.registry.pred_sig —
+    # shared by `_call_pred`, `precompile_pred`, the serve engine's
+    # compile-event accounting, and the sealed-bundle key encoding; a
+    # key-scheme change cannot strand one of them on a stale scheme
+    pred_sig = staticmethod(_areg.pred_sig)
 
     def _call_pred(self, data, mask, extra, nodes_wanted):
         sig = self.pred_sig(data.shape, data.dtype, mask is None,
@@ -744,13 +758,13 @@ class NetTrainer:
             mask_variants = [sds((n,), np.float32, self._b_shard)]
         do_up_variants = [True] if self.update_period == 1 \
             else [True, False]
-        dt_str = str(dtype)
         programs = []                    # (key, lower_thunk)
 
         for mask_v in (mask_variants if per_batch else []):
             for du in do_up_variants:
-                key = ("update", data_shape, dt_str, label_shape,
-                       mask_v is None, 0, bool(du))
+                key = ("update",) + _areg.update_sig(
+                    data_shape, dtype, label_shape, mask_v is None, 0,
+                    bool(du))
                 programs.append((key, lambda m=mask_v, d=du:
                                  self._train_step.lower(
                                      self.params, self.opt_state,
@@ -770,9 +784,9 @@ class NetTrainer:
                 epoch_k_s = sds((K,), np.uint32)
                 do_up_s = sds((K,), np.bool_)
                 collect = bool(self.eval_train and self._metrics.evals)
-                key = ("update_many", (K,) + data_shape, dt_str,
-                       (K,) + label_shape, mask_k is None, 0, K,
-                       collect)
+                key = ("update_many",) + _areg.update_many_sig(
+                    (K,) + data_shape, dtype, (K,) + label_shape,
+                    mask_k is None, 0, K, collect)
                 programs.append((key, lambda mk=mask_k, c=collect,
                                  ds=data_k_s, ls=labels_k_s,
                                  hs=hyper_k_s, es=epoch_k_s,
@@ -786,7 +800,7 @@ class NetTrainer:
             if self._metric_nodes:
                 nodes = tuple(self._metric_nodes)
                 key = ("pred",) + self.pred_sig(
-                    data_shape, dt_str, mask_v is None, 0, nodes)
+                    data_shape, dtype, mask_v is None, 0, nodes)
                 programs.append((key, lambda m=mask_v, nw=nodes:
                                  self._pred_step.lower(
                                      self.params, self.net_state,
@@ -805,8 +819,8 @@ class NetTrainer:
                             np.float32)
             epoch_k_s = sds((ns,), np.uint32)
             do_up_k_s = sds((ns,), np.bool_)
-            key = ("run_steps", data_shape, dt_str, label_shape,
-                   mask_rs is None, 0, ns)
+            key = ("run_steps",) + _areg.run_steps_sig(
+                data_shape, dtype, label_shape, mask_rs is None, 0, ns)
             programs.append((key, lambda m=mask_rs, hs=hyper_k_s,
                              es=epoch_k_s, us=do_up_k_s:
                              self._multi_step.lower(
@@ -825,34 +839,16 @@ class NetTrainer:
         return compiled
 
     def _compile_programs(self, programs, warn_code: str) -> int:
-        """AOT-compile ``(key, lower-thunk)`` pairs into ``_aot``,
-        skipping keys already compiled. The one compile loop behind
-        ``precompile`` and ``precompile_pred`` — failure fallback,
-        signature seeding and per-program telemetry must not drift
-        between the training and serving warmup paths."""
-        compiled = 0
-        for key, thunk in programs:
-            if key in self._aot:
-                continue
-            try:
-                t0 = time.perf_counter()
-                self._aot[key] = thunk().compile()
-            except Exception as e:
-                from ..monitor import warn_once
-                warn_once(warn_code,
-                          "precompile of %r failed (falling back to "
-                          "jit): %s" % (key[0], e))
-                continue
-            compiled += 1
-            # seed the signature set: the run's first dispatch of this
-            # signature is NOT a compile — it happened here, and the
-            # stream records it with its own wall time
-            self._seen_sigs.add(key)
-            if self._mon_on():
-                self._mon.emit("compile", kind="precompile",
-                               wall_ms=(time.perf_counter() - t0) * 1e3,
-                               signature=repr(key))
-        return compiled
+        """AOT-compile ``(key, lower-thunk)`` pairs into the program
+        registry, skipping keys already present (precompiled earlier,
+        or installed from a sealed artifact bundle). The registry's
+        ``compile`` is the one loop behind ``precompile`` and
+        ``precompile_pred`` — failure fallback, signature seeding and
+        per-program telemetry cannot drift between the training and
+        serving warmup paths."""
+        return self.programs.compile(
+            programs, warn_code,
+            monitor=self._mon if self._mon_on() else None)
 
     def precompile_pred(self, batch_sizes: Sequence[int],
                         nodes_wanted: Optional[Sequence[int]] = None,
@@ -1206,8 +1202,9 @@ class NetTrainer:
         step = self._step_scalar()
         self.sample_counter += 1
         do_update = self.sample_counter >= self.update_period
-        sig = (data.shape, str(data.dtype), labels.shape,
-               mask is None, len(extra), bool(do_update))
+        sig = _areg.update_sig(data.shape, data.dtype, labels.shape,
+                               mask is None, len(extra),
+                               bool(do_update))
         out = self._call_step(
             "update", sig, self._train_step,
             (self.params, self.opt_state, self.net_state, self.grad_acc,
@@ -1255,8 +1252,8 @@ class NetTrainer:
         epoch_k = np.asarray(epochs, np.uint32)  # cxxlint: disable=CXL003 -- host python list of schedule epochs
         do_up_k = np.asarray([((S + i + 1) % period) == 0  # cxxlint: disable=CXL003 -- host python list of apply flags
                               for i in range(n)])
-        sig = (data.shape, str(data.dtype), labels.shape,
-               mask is None, len(extra), n)
+        sig = _areg.run_steps_sig(data.shape, data.dtype, labels.shape,
+                                  mask is None, len(extra), n)
         out = self._call_step(
             "run_steps", sig, self._multi_step,
             (self.params, self.opt_state, self.net_state, self.grad_acc,
@@ -1316,8 +1313,9 @@ class NetTrainer:
             self._put_window([b.extra_data[j] for b in batches])
             for j in range(n_extra))
         collect = bool(self.eval_train and self._metrics.evals)
-        sig = (data_k.shape, str(data_k.dtype), labels_k.shape,
-               mask_k is None, n_extra, K, collect)
+        sig = _areg.update_many_sig(data_k.shape, data_k.dtype,
+                                    labels_k.shape, mask_k is None,
+                                    n_extra, K, collect)
         out = self._call_step(
             "update_many", sig, self._many_step,
             (self.params, self.opt_state, self.net_state, self.grad_acc,
@@ -1573,9 +1571,20 @@ class NetTrainer:
 
     def load_model(self, path: str) -> None:
         # verified read: digest + format_version checked before any
-        # array is trusted (checkpoint.read_snapshot)
+        # array is trusted (checkpoint.read_snapshot). A sealed
+        # artifact bundle (doc/artifacts.md) loads as its inner
+        # snapshot, then installs its serialized executables once the
+        # programs are rebuilt (_attach_bundle at the end).
         from .checkpoint import read_snapshot
-        blob, meta = read_snapshot(path)
+        bundle = None
+        from ..artifact import bundle as _ab
+        if _ab.is_bundle(path):
+            bundle = _ab.load_bundle(path)
+            path = bundle.snapshot_uri
+        # raw bytes ride from the bundle's verification pass so the
+        # snapshot is read once; the content digest still re-verifies
+        blob, meta = read_snapshot(
+            path, raw=bundle.snapshot_raw if bundle else None)
         saved_graph = NetGraph.from_dict(meta["structure"])
         self._absorb_globals()
         # re-parse config against saved structure (Configure equality
@@ -1621,6 +1630,25 @@ class NetTrainer:
                     self.opt_state[lk][tag] = new
             self.opt_state = jax.device_put(self.opt_state,
                                             self._o_shard)
+        if bundle is not None:
+            self._attach_bundle(bundle)
+
+    def _attach_bundle(self, bundle) -> None:
+        """Install a sealed bundle's serialized executables into the
+        program registry — AFTER ``_post_init`` rebuilt the dispatch
+        programs, so the installs land in the final registry. The
+        fingerprint gate is exact dict equality: platform, jax/jaxlib
+        versions, device kind+count, process count and mesh must all
+        match what the bundle was sealed on, or every key falls back
+        to re-lower+compile with one warning. Emits the honest
+        ``artifact_load`` accounting (hits + rebuilds == programs)."""
+        from ..artifact.bundle import runtime_fingerprint
+        fp_ok = bundle.manifest.get("fingerprint") \
+            == runtime_fingerprint(self.mesh)
+        rep = self.programs.install_serialized(
+            bundle.programs, bundle.path, fp_ok, monitor=self._mon)
+        if self._mon_on():
+            self._mon.emit("artifact_load", **rep)
 
     def copy_model_from(self, path: str) -> None:
         """Finetune: copy weights for layers whose *names* match

@@ -219,12 +219,22 @@ def build_engine(cfg, model_path: str,
                  buckets: Optional[Sequence[int]] = None,
                  max_batch: int = 0, node: str = "",
                  monitor=None) -> InferenceEngine:
-    """Load a snapshot into a frozen engine with a bucket-aligned mesh.
+    """Load a snapshot — or a sealed artifact bundle — into a frozen
+    engine with a bucket-aligned mesh.
 
     ``cfg`` is the ordered config-pair stream (netconfig + globals, the
     same stream ``NetTrainer`` takes). The mesh data axis is the
     largest device count that divides every bucket, so any ladder is
     servable on any host (a ladder with bucket 1 runs single-device).
+
+    When ``model_path`` is a bundle (doc/artifacts.md), the serve
+    contract the executables were sealed for fills any knob the config
+    left at its default: the manifest's bucket ladder replaces
+    ``auto``, its serve dtype applies when the config names none, and
+    its node likewise — so booting with the export-time config (or no
+    serve config at all) requests exactly the sealed keys and warmup
+    compiles nothing. Explicit config values still win; mismatched
+    keys just re-lower per key.
     """
     import jax
 
@@ -233,24 +243,44 @@ def build_engine(cfg, model_path: str,
     from ..parallel import make_mesh
     from .bucketing import mesh_align, parse_buckets
     cfg = list(cfg)
-    serve_dtype = "float32"
+    serve_dtype = ""
     if not max_batch:
         for k, v in cfg:
             if k == "batch_size":
                 max_batch = int(v)
-        if not max_batch:
-            raise ValueError("serve needs batch_size (or serve_max_batch)")
     for k, v in cfg:
         if k == "serve_dtype":
             serve_dtype = normalize_serve_dtype(v)
+    from ..artifact import bundle as _ab
+    manifest = None
+    if _ab.is_bundle(model_path):
+        manifest = _ab.bundle_manifest(model_path)
+        if buckets is None or buckets in ("", "auto"):
+            buckets = tuple(int(b) for b in manifest["buckets"])
+        if not max_batch:
+            max_batch = max(manifest["buckets"])
+        if not serve_dtype and manifest.get("serve_dtype"):
+            serve_dtype = normalize_serve_dtype(
+                manifest["serve_dtype"])
+            # the trainer must build the SAME graph the executables
+            # were sealed from (quantized dtypes change the traced
+            # forward); appended last so it wins inside the trainer
+            cfg = cfg + [("serve_dtype", serve_dtype)]
+        if not node and manifest.get("node"):
+            node = manifest["node"]
+    serve_dtype = serve_dtype or "float32"
+    if not max_batch:
+        raise ValueError("serve needs batch_size (or serve_max_batch)")
     spec = buckets if isinstance(buckets, str) else ""
     if isinstance(buckets, str) or buckets is None:
         buckets = parse_buckets(spec, max_batch)
     align = mesh_align(buckets, len(jax.devices()))
     trainer = NetTrainer(cfg, mesh=make_mesh(align, 1))
-    trainer.load_model(model_path)
     if monitor is not None:
+        # monitor BEFORE load: a bundle load emits its artifact_load
+        # hit/rebuild accounting during load_model
         trainer.set_monitor(monitor)
+    trainer.load_model(model_path)
     return InferenceEngine(trainer, buckets=buckets, node=node,
                            monitor=monitor,
                            input_dtype=input_dtype_for(serve_dtype))

@@ -312,13 +312,17 @@ class FleetServer:
 
     @staticmethod
     def _resolve_source(src: str) -> Tuple[int, str, str]:
-        """A model source is a snapshot file (PINNED: served as-is, no
-        watcher — naming an exact snapshot is a deliberate version
-        pin) or a model_dir (serve the newest verified snapshot and
-        hot-swap as newer ones commit). Returns (counter,
-        snapshot_path, dir_to_watch) — watch dir "" means pinned."""
+        """A model source is a snapshot file or sealed artifact bundle
+        (PINNED: served as-is, no watcher — naming an exact artifact
+        is a deliberate version pin) or a model_dir (serve the newest
+        verified snapshot/bundle and hot-swap as newer ones commit).
+        Returns (counter, snapshot_path, dir_to_watch) — watch dir ""
+        means pinned."""
+        from ..artifact.bundle import is_bundle
         from ..utils.stream import stream_exists
         if src.endswith(".npz") and stream_exists(src):
+            return counter_of(src), src, ""
+        if is_bundle(src):
             return counter_of(src), src, ""
         counter, path = latest_verified(src)
         if path is None:

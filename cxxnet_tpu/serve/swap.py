@@ -46,28 +46,50 @@ from ..nnet.checkpoint import (MODEL_RE, scan_snapshots, snapshot_uri,
 from .router import ModelRouter
 
 
-def latest_verified(model_dir: str) -> Tuple[Optional[int],
-                                             Optional[str]]:
-    """Newest snapshot in ``model_dir`` that passes
-    ``verify_snapshot``, as (counter, uri); (None, None) when none
-    does. Read-only — safe against a model_dir a live training run is
-    committing into (see module docstring)."""
+def latest_verified(model_dir: str, min_counter: int = -1,
+                    ) -> Tuple[Optional[int], Optional[str]]:
+    """Newest verified model in ``model_dir`` — a snapshot that
+    passes ``verify_snapshot`` or a sealed artifact bundle that
+    passes ``verify_bundle`` — as (counter, uri); (None, None) when
+    none does. At equal counters the bundle wins: flipping to a
+    bundle skips the shadow build's compile time entirely
+    (doc/artifacts.md). ``min_counter`` prunes candidates the caller
+    would discard anyway BEFORE verification — the watcher's idle
+    poll must not re-hash a multi-GB artifact every 2 seconds just to
+    compare counters afterwards. (A bundle the caller then *boots*
+    is read and hashed again by ``load_bundle`` — deliberately: the
+    verification of record belongs to the load, since the artifact
+    can change between scan and boot.) Read-only — safe against a
+    model_dir a live writer (training run or exporter) is committing
+    into (see module docstring)."""
+    from ..artifact.bundle import scan_bundles, verify_bundle
     try:
-        candidates = scan_snapshots(model_dir)
+        candidates = [(counter, name, False)
+                      for counter, name in scan_snapshots(model_dir)]
+        candidates += [(counter, name, True)
+                       for counter, name in scan_bundles(model_dir)]
     except (IOError, OSError):
         return None, None
-    for counter, name in candidates:
+    # newest first; bundle before snapshot at the same counter
+    candidates.sort(key=lambda c: (c[0], c[2]), reverse=True)
+    for counter, name, is_bundle in candidates:
+        if counter <= min_counter:
+            break                        # sorted: nothing newer left
         uri = snapshot_uri(model_dir, name)
-        if verify_snapshot(uri)["ok"]:
+        rep = verify_bundle(uri) if is_bundle else verify_snapshot(uri)
+        if rep["ok"]:
             return counter, uri
     return None, None
 
 
 def counter_of(path: str) -> int:
-    """Snapshot counter from a ``NNNN.model.npz`` basename (0 when the
-    name does not follow the convention — e.g. an explicit model_in
-    file — so any watched counter >= 1 upgrades it)."""
-    m = MODEL_RE.match(os.path.basename(path))
+    """Snapshot/bundle counter from a ``NNNN.model.npz`` /
+    ``NNNN.model.bundle`` basename (0 when the name follows neither
+    convention — e.g. an explicit model_in file — so any watched
+    counter >= 1 upgrades it)."""
+    from ..artifact.bundle import BUNDLE_RE
+    base = os.path.basename(path.rstrip("/"))
+    m = MODEL_RE.match(base) or BUNDLE_RE.match(base)
     return int(m.group(1)) if m else 0
 
 
@@ -102,6 +124,12 @@ class SnapshotWatcher:
         self._lock = threading.Lock()
         self.swaps = 0
         self.failed_builds = 0
+        # negative cache for the same-counter bundle-upgrade probe:
+        # uri -> the commit-marker bytes that failed verification. A
+        # corrupt bundle beside the served snapshot must not be fully
+        # re-hashed every poll; a re-export rewrites the marker, which
+        # invalidates the entry and retries
+        self._bad_upgrade: Dict[str, bytes] = {}
 
     # -- the swap core ----------------------------------------------------
 
@@ -112,14 +140,26 @@ class SnapshotWatcher:
         current engine serving. Serialized: a concurrent call blocks,
         then sees the freshly swapped counter and does nothing."""
         with self._lock:
-            counter, path = latest_verified(self.model_dir)
-            if counter is None:
-                return None
             try:
                 current = self.router.resolve(self.model_id)
             except KeyError:
                 return None
-            if counter <= current.counter:
+            # resolve BEFORE the scan so already-served counters are
+            # pruned pre-verification: the idle poll (no newer
+            # artifact) costs a directory listing, not a full re-hash
+            # of the currently served bundle every poll_s seconds
+            counter, path = latest_verified(
+                self.model_dir, min_counter=current.counter)
+            if counter is None:
+                # no strictly-newer artifact — but an export may have
+                # just sealed the COUNTER WE ARE SERVING into a
+                # bundle (the headline deploy loop): a same-counter
+                # snapshot->bundle upgrade swaps too, so subsequent
+                # swaps and restarts skip compiles
+                counter, path = self._bundle_upgrade(current)
+                if counter is None:
+                    return None
+            if counter < current.counter or path == current.path:
                 return None
             t0 = time.monotonic()
             try:
@@ -173,6 +213,61 @@ class SnapshotWatcher:
                                "hot_swap record for model %r could "
                                "not be emitted" % self.model_id)
             return rec
+
+    def _bundle_upgrade(self, current) -> Tuple[Optional[int],
+                                                Optional[str]]:
+        """Probe for a committed bundle at the CURRENTLY SERVED
+        counter while the entry still serves a snapshot — cheap
+        (directory listing + marker existence) until such a bundle
+        appears, full verification only then. (None, None) when
+        already on a bundle or none exists."""
+        from ..artifact.bundle import (BUNDLE_RE, scan_bundles,
+                                       verify_bundle)
+        if BUNDLE_RE.match(os.path.basename(
+                (current.path or "").rstrip("/"))):
+            return None, None            # already serving a bundle
+        try:
+            bundles = scan_bundles(self.model_dir)
+        except (IOError, OSError):
+            return None, None
+        for c, name in bundles:
+            if c != current.counter:
+                continue
+            uri = snapshot_uri(self.model_dir, name)
+            if uri == current.path:
+                return None, None
+            marker = self._read_marker(uri)
+            if marker is not None \
+                    and self._bad_upgrade.get(uri) == marker:
+                return None, None        # same failed bytes: skip
+            # the shadow build's load_bundle re-verifies at read time
+            # (verification-of-record belongs to the load; the
+            # artifact can change between this poll and the flip)
+            rep = verify_bundle(uri)
+            if rep["ok"]:
+                self._bad_upgrade.pop(uri, None)
+                return c, uri
+            if marker is not None:
+                self._bad_upgrade[uri] = marker
+            self._warn("bundle_upgrade_invalid:%s" % uri,
+                       "bundle %s at the served counter fails "
+                       "verification (%s); staying on the snapshot "
+                       "(re-export to retry)" % (uri, rep["error"]))
+            return None, None
+        return None, None
+
+    @staticmethod
+    def _read_marker(uri: str):
+        """The bundle's tiny commit-marker bytes (the negative-cache
+        key), or None when unreadable."""
+        from ..artifact.bundle import MANIFEST_NAME, OK_SUFFIX, \
+            member_uri
+        from ..utils.stream import read_stream_bytes
+        try:
+            return read_stream_bytes(
+                member_uri(uri, MANIFEST_NAME + OK_SUFFIX))
+        except (IOError, OSError):
+            return None
 
     def _warn(self, code: str, message: str) -> None:
         if self._mon is not None:

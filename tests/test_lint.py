@@ -342,7 +342,7 @@ def test_watcher_concurrent_check_once_single_swap(tmp_path, monkeypatch):
     router.register("m", FakeSession(), counter=1, path="old")
 
     monkeypatch.setattr(swap_mod, "latest_verified",
-                        lambda d: (2, "snap-2"))
+                        lambda d, min_counter=-1: (2, "snap-2"))
     started = threading.Event()
     release = threading.Event()
     builds = []

@@ -1,16 +1,20 @@
 #!/usr/bin/env python
-"""Offline snapshot integrity checker — the operator's first debugging
-step when a resume misbehaves (doc/checkpointing.md).
+"""Offline snapshot/artifact integrity checker — the operator's first
+debugging step when a resume or artifact boot misbehaves
+(doc/checkpointing.md, doc/artifacts.md).
 
-For each argument (a snapshot file, or a model_dir to scan — local
-path or remote URI, anything the stream layer opens) it reports
-structural loadability, the content digest verdict, the format
-version, and (remote) the commit-manifest cross-check::
+For each argument (a snapshot file, a sealed artifact bundle, or a
+model_dir to scan — local path or remote URI, anything the stream
+layer opens) it reports structural loadability, the content digest
+verdict, the format version, and (remote) the commit-manifest
+cross-check. Bundles additionally verify every member's sha256 (the
+serialized executables included) and the snapshot inside::
 
     python tools/ckpt_verify.py ./models
     python tools/ckpt_verify.py gs://bucket/run7/0042.model.npz
+    python tools/ckpt_verify.py ./models/0042.model.bundle
 
-Exit status: 0 = every checked snapshot verifies; 1 = at least one is
+Exit status: 0 = every checked artifact verifies; 1 = at least one is
 corrupt, truncated, digest-mismatched, or format-incompatible (an
 empty model_dir is not corruption); 2 = usage error. The fault-matrix
 tests drive this binary against injected ENOSPC/truncation/torn-commit
@@ -26,10 +30,22 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from cxxnet_tpu.artifact.bundle import (BUNDLE_RE, is_bundle,
+                                        scan_bundles, verify_bundle)
 from cxxnet_tpu.nnet.checkpoint import (MODEL_RE, scan_snapshots,
                                         snapshot_uri, verify_snapshot)
 from cxxnet_tpu.utils.stream import (list_stream_dir, stream_exists,
                                      uri_scheme)
+
+
+def _bundle_target(target: str) -> bool:
+    """A target to verify as a bundle: any directory holding a
+    manifest, or anything NAMED like a bundle — a vanished/tampered
+    manifest on a ``NNNN.model.bundle`` path must report CORRUPT
+    (exit 1), never fall through to an empty-dir all-clear."""
+    if is_bundle(target):
+        return True
+    return bool(BUNDLE_RE.match(target.rstrip("/").rsplit("/", 1)[-1]))
 
 
 def _is_dir(target: str) -> bool:
@@ -57,6 +73,22 @@ def _check(path: str, quiet: bool) -> bool:
     return False
 
 
+def _check_bundle(path: str, quiet: bool) -> bool:
+    """Verify a sealed artifact bundle: commit marker, manifest sha,
+    every member digest (executables included), and the snapshot
+    inside — a tampered byte anywhere fails the whole bundle."""
+    rep = verify_bundle(path)
+    if rep["ok"]:
+        if not quiet:
+            print("OK       %s  (bundle, format v%d, %d members, "
+                  "%d programs)"
+                  % (path, rep["format_version"], rep["members"],
+                     rep["programs"]))
+        return True
+    print("CORRUPT  %s  (bundle: %s)" % (path, rep["error"]))
+    return False
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="ckpt_verify",
@@ -71,8 +103,17 @@ def main(argv=None) -> int:
     checked = 0
     bad = 0
     for target in args.targets:
-        if _is_dir(target):
+        if _bundle_target(target):
+            # an explicitly named bundle must verify commit marker
+            # and all: an uncommitted one is a failure here (naming
+            # it means you expect it deployable), unlike the
+            # skip-and-report treatment inside a dir scan
+            checked += 1
+            if not _check_bundle(target, args.quiet):
+                bad += 1
+        elif _is_dir(target):
             names = [n for _, n in scan_snapshots(target)]
+            bundles = [n for _, n in scan_bundles(target)]
             # uncommitted remote payloads (no .ok) are *reported* but
             # not counted as corruption: resume ignores them by design
             listing = set(list_stream_dir(target))
@@ -82,11 +123,24 @@ def main(argv=None) -> int:
                         print("UNCOMMITTED %s  (payload without "
                               "commit manifest; resume ignores it)"
                               % snapshot_uri(target, n))
-            if not names and not args.quiet:
-                print("EMPTY    %s  (no committed snapshots)" % target)
+            # uncommitted bundles likewise: the exporter may still be
+            # writing them, and the hot-swap watcher skips them
+            for n in sorted(listing):
+                if BUNDLE_RE.match(n) and n not in bundles:
+                    print("UNCOMMITTED %s  (bundle without commit "
+                          "marker; the watcher ignores it)"
+                          % snapshot_uri(target, n))
+            if not names and not bundles and not args.quiet:
+                print("EMPTY    %s  (no committed snapshots or "
+                      "bundles)" % target)
             for n in names:
                 checked += 1
                 if not _check(snapshot_uri(target, n), args.quiet):
+                    bad += 1
+            for n in bundles:
+                checked += 1
+                if not _check_bundle(snapshot_uri(target, n),
+                                     args.quiet):
                     bad += 1
         else:
             checked += 1

@@ -63,15 +63,27 @@ eta = 0.1
 """
 
 
-def build_session(args, monitor):
+def build_session(args, monitor, via: str = ""):
+    """``via`` selects the boot source when both are configured:
+    "artifact" (the sealed bundle), "snapshot" (--conf/--model-in),
+    or "" = artifact when given, else snapshot/synthetic."""
     from cxxnet_tpu.serve import InferenceEngine, ServeSession
     from cxxnet_tpu.utils.config import parse_config, parse_config_file
     serve_pairs = [
         ("serve_buckets", args.buckets),
         ("serve_max_delay_ms", str(args.max_delay_ms)),
         ("serve_queue_rows", str(args.queue_rows)),
-        ("serve_dtype", args.serve_dtype),
     ]
+    if args.serve_dtype:
+        serve_pairs.append(("serve_dtype", args.serve_dtype))
+    if args.artifact and via != "snapshot":
+        # conf-less boot: the serve contract (bucket ladder, dtype,
+        # node, max batch) comes from the sealed manifest; explicit
+        # CLI knobs appended after it still win
+        from cxxnet_tpu.artifact.bundle import serve_cfg_from_bundle
+        cfg = serve_cfg_from_bundle(args.artifact) + serve_pairs
+        return ServeSession(cfg, model_path=args.artifact,
+                            monitor=monitor)
     if args.conf:
         cfg = parse_config_file(args.conf) + serve_pairs
         assert args.model_in, "--conf needs --model-in"
@@ -131,6 +143,43 @@ def sweep_point(args, clients, monitor, sink):
     if mfu is not None:
         pt["mfu"] = mfu
     return pt
+
+
+def measure_cold_start(args, monitor, sink, via):
+    """Cold-start column: boot a FRESH session (load + program
+    acquisition + warmup) and time to the first served reply, with
+    the compile count over the whole window read from the telemetry
+    stream — the artifact win lands in a bench record, not a claim.
+    ``via`` = "artifact" boots the sealed bundle, "snapshot" the
+    --conf/--model-in pair (the re-compile baseline column)."""
+    sink.clear()
+    t0 = time.perf_counter()
+    session = build_session(args, monitor, via=via)
+    boot_s = time.perf_counter() - t0
+    inst = session.engine._inst_shape()
+    t1 = time.perf_counter()
+    session.predict(np.zeros((1,) + inst, np.float32))
+    first_reply_ms = (time.perf_counter() - t1) * 1e3
+    session.close()
+    compiles = [r for r in sink.records if r["event"] == "compile"]
+    art = next((r for r in sink.records
+                if r["event"] == "artifact_load"), None)
+    col = {
+        "via": via,
+        "source": args.artifact if via == "artifact"
+        else args.model_in,
+        "boot_s": round(boot_s, 3),
+        "first_reply_ms": round(first_reply_ms, 3),
+        "time_to_first_reply_s": round(boot_s + first_reply_ms / 1e3,
+                                       3),
+        "compile_events": len(compiles),
+        "warmup_programs": int(session.warmup_programs),
+    }
+    if art is not None:
+        col["artifact_hits"] = art["hits"]
+        col["artifact_rebuilds"] = art["rebuilds"]
+        col["fingerprint_match"] = art["fingerprint_match"]
+    return col
 
 
 def serve_mfu(records, rows_per_sec, peak_tflops):
@@ -193,7 +242,7 @@ def run_multi_tenant(args, monitor, sink):
         ("serve_buckets", args.buckets),
         ("serve_max_delay_ms", str(args.max_delay_ms)),
         ("serve_queue_rows", str(args.queue_rows)),
-        ("serve_dtype", args.serve_dtype),
+        ("serve_dtype", args.serve_dtype or "float32"),
         ("serve_http_port", "-1"),
         ("serve_binary_port", "0"),
         ("serve_swap_poll_s", "0"),
@@ -315,7 +364,7 @@ def run_multi_tenant(args, monitor, sink):
         "mode": "multi_tenant",
         "t": time.time(),
         "model": args.conf or "synthetic_mlp_256_64_10",
-        "dtype": args.serve_dtype,
+        "dtype": args.serve_dtype or "float32",
         "buckets": args.buckets,
         "max_delay_ms": args.max_delay_ms,
         "requests_per_client": args.requests,
@@ -347,6 +396,13 @@ def main(argv=None) -> int:
                     help="config file (with --model-in) instead of the "
                          "synthetic MLP")
     ap.add_argument("--model-in", default="")
+    ap.add_argument("--artifact", default="",
+                    help="sealed artifact bundle (task=export, "
+                         "doc/artifacts.md) to boot every session "
+                         "from; adds a cold-start column (time-to-"
+                         "first-reply, compile count) to the record — "
+                         "plus the snapshot-boot baseline column when "
+                         "--conf/--model-in are also given")
     ap.add_argument("--out", default="",
                     help="also write the JSON record to this path")
     ap.add_argument("--tenants", default="",
@@ -356,21 +412,29 @@ def main(argv=None) -> int:
     ap.add_argument("--slo-p99-ms", type=float, default=0.0,
                     help="per-tenant ok-request p99 SLO; breach "
                          "exits 3 (0 = no assertion)")
-    ap.add_argument("--serve-dtype", default="float32",
-                    choices=["float32", "bfloat16", "int8", "fp8"],
+    ap.add_argument("--serve-dtype", default="",
+                    choices=["", "float32", "bfloat16", "int8", "fp8"],
                     help="serve_dtype for the engine (int8/fp8 need a "
-                         "task=quantize calibrated --model-in); the "
-                         "record is dtype-tagged")
+                         "task=quantize calibrated --model-in or a "
+                         "quantized --artifact); the record is "
+                         "dtype-tagged. Default: the artifact's "
+                         "sealed dtype, else float32")
     ap.add_argument("--peak-tflops", type=float, default=0.0,
                     help="chip peak TFLOP/s for the serve dtype; when "
                          "set, every sweep point carries an MFU column "
                          "from the model_info analytic FLOPs — "
                          "comparable with bench.py's train MFU")
     args = ap.parse_args(argv)
-    if args.serve_dtype in ("int8", "fp8") and not args.conf:
+    if args.serve_dtype in ("int8", "fp8") and not args.conf \
+            and not args.artifact:
         ap.error("--serve-dtype %s needs a task=quantize calibrated "
-                 "snapshot: pass --conf/--model-in (the synthetic MLP "
-                 "has no calibration ranges)" % args.serve_dtype)
+                 "snapshot: pass --conf/--model-in or --artifact (the "
+                 "synthetic MLP has no calibration ranges)"
+                 % args.serve_dtype)
+    if args.artifact and args.tenants:
+        ap.error("--artifact drives the closed-loop sweep; drop "
+                 "--tenants (fleet configs name bundles in "
+                 "serve_models instead)")
 
     from cxxnet_tpu.monitor import MemorySink, Monitor
     import jax
@@ -391,6 +455,36 @@ def main(argv=None) -> int:
         if not zero_recompiles:
             return 1
         return 0 if slo_ok else 3
+    rec_dtype = args.serve_dtype or "float32"
+    if not args.serve_dtype and args.conf and not args.artifact:
+        # the conf's serve_dtype drives the engine when the flag is
+        # unset — the record tag must say what was actually measured
+        # (cross-dtype rows/s comparisons are not a signal)
+        from cxxnet_tpu.nnet.quantize import normalize_serve_dtype
+        from cxxnet_tpu.utils.config import parse_config_file
+        for k, v in parse_config_file(args.conf):
+            if k == "serve_dtype":
+                rec_dtype = normalize_serve_dtype(v)
+    cold_start = None
+    if args.artifact:
+        # cold-start columns FIRST (each is a fresh boot with clean
+        # telemetry); the artifact column is the headline, the
+        # snapshot column (when a --conf/--model-in baseline is
+        # available) is what it saves
+        cold_start = [measure_cold_start(args, monitor, sink,
+                                         "artifact")]
+        if args.conf and args.model_in:
+            cold_start.append(measure_cold_start(args, monitor, sink,
+                                                 "snapshot"))
+        for c in cold_start:
+            print("# cold-start via %s: boot %.2fs, first reply "
+                  "%.1f ms, ttfr %.2fs, compiles %d"
+                  % (c["via"], c["boot_s"], c["first_reply_ms"],
+                     c["time_to_first_reply_s"], c["compile_events"]),
+                  file=sys.stderr)
+        if not args.serve_dtype:
+            from cxxnet_tpu.artifact.bundle import bundle_manifest
+            rec_dtype = bundle_manifest(args.artifact)["serve_dtype"]
     points = []
     for clients in [int(t) for t in args.clients.split(",") if t]:
         t0 = time.time()
@@ -406,8 +500,9 @@ def main(argv=None) -> int:
         "name": "serve_bench",
         "t": time.time(),
         "platform": jax.default_backend(),
-        "model": args.conf or "synthetic_mlp_256_64_10",
-        "dtype": args.serve_dtype,
+        "model": args.artifact or args.conf
+        or "synthetic_mlp_256_64_10",
+        "dtype": rec_dtype,
         "buckets": args.buckets,
         "max_delay_ms": args.max_delay_ms,
         "requests_per_client": args.requests,
@@ -416,6 +511,8 @@ def main(argv=None) -> int:
         "zero_recompiles": all(p["compile_events"] == 0
                                for p in points),
     }
+    if cold_start is not None:
+        rec["cold_start"] = cold_start
     out = json.dumps(rec, sort_keys=True)
     print(out)
     if args.out:
