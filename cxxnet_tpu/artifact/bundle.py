@@ -240,6 +240,12 @@ def export_bundle(engine, out: str, node: str = "",
         "node": node,
         "serve_dtype": trainer.serve_dtype,
         "input_dtype": str(engine.input_dtype),
+        # the sealed executables' weight calling convention: 1 = pred
+        # takes the frozen device-resident serve tree as arguments
+        # (trainer.freeze_serve_weights), 0 = the raw master tree. A
+        # boot whose trainer uses the other convention re-lowers per
+        # key instead of calling with the wrong pytree
+        "weight_residency": int(bool(trainer.serve_weight_residency)),
         "config_hash": config_hash(trainer.cfg),
         "content_digest": snap_stats["digest"],
         "snapshot": SNAPSHOT_MEMBER,
@@ -534,6 +540,9 @@ def serve_cfg_from_bundle(path: str) -> List[Tuple[str, str]]:
         ("serve_max_batch", str(max(man["buckets"]))),
         ("serve_dtype", man["serve_dtype"]),
     ]
+    if "weight_residency" in man:
+        pairs.append(("serve_weight_residency",
+                      str(int(man["weight_residency"]))))
     if man.get("node"):
         pairs.append(("serve_node", man["node"]))
     return pairs

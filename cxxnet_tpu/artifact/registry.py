@@ -36,7 +36,64 @@ from __future__ import annotations
 import ast
 import pickle
 import time
-from typing import Any, Callable, Dict, List, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class ResidencyBudgetError(RuntimeError):
+    """Loading (or hot-swapping in) a model would exceed the explicit
+    ``serve_device_mem_budget`` — the memory-honest alternative to
+    discovering the overcommit as a device OOM mid-request. The load
+    is rejected whole; whatever was serving keeps serving."""
+
+
+class WeightResidency:
+    """The device-resident serve weight tree and its accounting.
+
+    One per model: the eval-transformed parameter tree every bucket
+    executable of that model consumes as *arguments* (so N buckets
+    share ONE device copy — the closure-constant alternative would
+    bake the transformed weights into every executable). Built once at
+    load/freeze by ``NetTrainer.freeze_serve_weights``:
+
+    - ``bn_fold_eval`` weight folds applied once (no per-dispatch
+      ``w * fold_scale`` pass),
+    - int8/fp8 weights quantized once (no per-dispatch round/clip/cast
+      of the weight tensor in the traced graph),
+    - bf16 serve weights pre-cast (half the resident weight bytes),
+    - per-channel dequant/shift epilogue vectors materialized as tree
+      leaves instead of closure constants.
+
+    ``tree_bytes`` is the footprint of the tree the executables see;
+    ``total_bytes`` additionally counts the retained f32 masters,
+    deduplicated by buffer identity (untransformed leaves alias the
+    masters and are counted once) — the number budget enforcement and
+    the ``weight_residency`` telemetry record report.
+    """
+
+    __slots__ = ("tree", "tree_bytes", "master_bytes", "total_bytes",
+                 "quantize_ms", "layers", "dtype", "active")
+
+    def __init__(self, tree, tree_bytes: int, master_bytes: int,
+                 total_bytes: int, quantize_ms: float, layers: int,
+                 dtype: str, active: bool):
+        self.tree = tree
+        self.tree_bytes = int(tree_bytes)
+        self.master_bytes = int(master_bytes)
+        self.total_bytes = int(total_bytes)
+        self.quantize_ms = float(quantize_ms)
+        self.layers = int(layers)
+        self.dtype = dtype
+        self.active = bool(active)
+
+    def record(self) -> Dict[str, Any]:
+        """The ``weight_residency`` telemetry record fields."""
+        return {"bytes": self.total_bytes,
+                "tree_bytes": self.tree_bytes,
+                "master_bytes": self.master_bytes,
+                "quantize_ms": self.quantize_ms,
+                "layers": self.layers,
+                "dtype": self.dtype,
+                "active": self.active}
 
 # -- the dispatch-signature scheme ----------------------------------------
 #
@@ -113,6 +170,11 @@ class ProgramRegistry:
         # re-export must copy these keys' original blobs from the
         # source bundle instead of serializing the live object
         self.installed: set = set()
+        # the device-resident serve weight tree (None until the owning
+        # trainer freezes its serve weights); every pred executable of
+        # this registry consumes it as arguments, so the tree is shared
+        # across the whole bucket ladder
+        self.residency: Optional[WeightResidency] = None
 
     # -- lookup ----------------------------------------------------------
 
@@ -136,6 +198,22 @@ class ProgramRegistry:
         self.art_hits = 0
         self.art_rebuilds = 0
         self.installed = set()
+        self.residency = None            # tree built for the old graph
+
+    def install_weights(self, residency: WeightResidency,
+                        budget_bytes: int = 0) -> WeightResidency:
+        """Adopt a frozen serve weight tree, enforcing the explicit
+        device-memory budget (0 = unlimited). Raises
+        :class:`ResidencyBudgetError` — a typed rejection, not an OOM —
+        when the model's resident bytes exceed the budget; nothing is
+        installed in that case."""
+        if budget_bytes and residency.total_bytes > budget_bytes:
+            raise ResidencyBudgetError(
+                "model weight tree needs %d resident bytes but "
+                "serve_device_mem_budget allows %d"
+                % (residency.total_bytes, budget_bytes))
+        self.residency = residency
+        return residency
 
     # -- the one compile loop --------------------------------------------
 
@@ -152,8 +230,15 @@ class ProgramRegistry:
             if key in self.aot:
                 continue
             try:
+                import warnings
                 t0 = time.perf_counter()
-                self.aot[key] = thunk().compile()
+                with warnings.catch_warnings():
+                    # donated pred buffers that XLA cannot alias into
+                    # the (differently shaped) outputs warn per
+                    # compile; donation is best-effort by design
+                    warnings.filterwarnings(
+                        "ignore", message=".*[Dd]onat")
+                    self.aot[key] = thunk().compile()
             except Exception as e:
                 from ..monitor import warn_once
                 warn_once(warn_code,

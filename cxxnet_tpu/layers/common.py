@@ -72,9 +72,20 @@ class FullConnectLayer(Layer):
         # operands and the per-out-channel dequant rides the epilogue
         q = None if is_train else getattr(self, "_quant", None)
         if q is not None and q.is_affine:
-            y = jnp.dot(q.quantize_x(x), q.quantize_w(w),
-                        preferred_element_type=q.acc_dtype())
-            y = y.astype(jnp.float32) * q.dequant_vec()
+            # device-resident serve weights: ``_r_dequant`` in the tree
+            # means the weight arrived pre-quantized at freeze — the
+            # per-dispatch weight round/clip/cast disappears and the
+            # dequant vector rides as an argument instead of a closure
+            # constant baked into every bucket executable
+            dq = params.get("_r_dequant")
+            if dq is not None:
+                y = jnp.dot(q.quantize_x(x), w,
+                            preferred_element_type=q.acc_dtype())
+                y = y.astype(jnp.float32) * dq
+            else:
+                y = jnp.dot(q.quantize_x(x), q.quantize_w(w),
+                            preferred_element_type=q.acc_dtype())
+                y = y.astype(jnp.float32) * q.dequant_vec()
             if self.param.no_bias == 0:
                 y = y + params["bias"]
             return [y], state

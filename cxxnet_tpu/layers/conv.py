@@ -151,6 +151,16 @@ class ConvolutionLayer(Layer):
         # the eval/pred forward ever consults it
         q = None if is_train else getattr(self, "_quant", None)
         quant = q is not None and q.is_affine
+        if not is_train:
+            # device-resident serve weights (trainer.freeze_serve_
+            # weights): the fold/quantize/cast already happened ONCE at
+            # freeze, so ``w`` arrives pre-transformed and the ``_r_*``
+            # epilogue vectors ride the tree as arguments. Key presence
+            # is static (pytree structure), so this branch costs
+            # nothing when the tree is the raw master tree.
+            out = self._forward_resident(params, state, x, w, q)
+            if out is not None:
+                return out
         # BN epilogue folded into the conv (eval/pred path): the net's
         # bn_fold_eval pass injects the per-out-channel _fold_scale /
         # _fold_shift (from the BN's running stats) and the downstream
@@ -252,6 +262,60 @@ class ConvolutionLayer(Layer):
         # save_only_these_names("conv_out") the backward keeps conv
         # outputs and recomputes BN/activation/pool between them;
         # identity when no checkpoint policy is active
+        y = checkpoint_name(y, "conv_out")
+        return [y], state
+
+    def _forward_resident(self, params, state, x, w, q):
+        """Eval forward over a frozen serve weight tree, or None when
+        ``params`` carries no residency markers (legacy path). The
+        arithmetic mirrors the in-graph fold/quantize path op for op —
+        the tree just holds the weight-side results precomputed — so
+        outputs are bit-identical to the legacy trace."""
+        p = self.param
+        relu = False
+        shift = params.get("_r_shift")
+        if shift is None:
+            shift = params.get("_r_shift_relu")
+            relu = shift is not None
+        if shift is None:
+            return None
+        dq = params.get("_r_dequant")
+        if dq is not None:
+            # w is pre-quantized (and pre-folded); only the batch-sized
+            # activation quantizes per dispatch
+            y = jax.lax.conv_general_dilated(
+                q.quantize_x(x), w,
+                window_strides=(p.stride, p.stride),
+                padding=[(p.pad_y, p.pad_y), (p.pad_x, p.pad_x)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=p.num_group,
+                preferred_element_type=q.acc_dtype())
+            bf16 = (p.compute_dtype == "bfloat16"
+                    or q.dtype == "bfloat16")
+            out_dtype = jnp.bfloat16 if bf16 else jnp.float32
+            from .pallas_kernels import (conv_epilogue,
+                                         conv_epilogue_applicable)
+            if p.conv_pallas_epilogue \
+                    and conv_epilogue_applicable(y.shape):
+                y = conv_epilogue(y, dq.astype(jnp.float32),
+                                  shift.astype(jnp.float32), relu,
+                                  out_dtype)
+            else:
+                yf = y.astype(jnp.float32) * dq + shift
+                if relu:
+                    yf = jax.nn.relu(yf)
+                y = yf.astype(out_dtype)
+        else:
+            # pre-folded (and possibly pre-cast) float weights
+            bf16 = (p.compute_dtype == "bfloat16"
+                    or (q is not None and q.dtype == "bfloat16"))
+            if bf16:
+                x = x.astype(jnp.bfloat16)
+                w = w.astype(jnp.bfloat16)   # no-op: tree holds bf16
+            y = self._float_conv(x, w, bf16)
+            y = y + shift.astype(y.dtype)
+            if relu:
+                y = jax.nn.relu(y)
         y = checkpoint_name(y, "conv_out")
         return [y], state
 

@@ -22,6 +22,11 @@ PROGRAM_BUILDERS = {
         "NetTrainer.precompile",
         "NetTrainer.precompile_pred",
         "NetTrainer._compile_programs",
+        # the one-time serve weight-residency upload: folds/quantizes/
+        # casts the eval weight tree on device at freeze
+        # (doc/serving.md "Device memory accounting") — never
+        # dispatched per request
+        "NetTrainer._build_resident_prep",
     ),
     # the program registry (doc/artifacts.md): the one compile loop
     # every (key, lower-thunk) pair goes through, and the sealed-

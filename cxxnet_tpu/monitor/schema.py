@@ -91,6 +91,13 @@ REQUIRED: Dict[str, tuple] = {
                  "parity_max_abs", "parity_mean_abs", "agree_rate",
                  "out", "wall_ms"),
     "quantized_model": ("dtype", "layers", "fallback_layers", "native"),
+    # device-resident serve weights (doc/serving.md "Device memory
+    # accounting"): emitted at freeze — per-model resident device
+    # bytes (tree + retained masters, buffer-deduplicated), the
+    # one-time quantize/fold wall time, and how many layers hoisted
+    # their per-dispatch weight work into the freeze
+    "weight_residency": ("bytes", "tree_bytes", "master_bytes",
+                         "quantize_ms", "layers", "dtype", "active"),
     # sealed model artifacts (doc/artifacts.md): the task=export
     # rollup, and the honest per-boot accounting of a bundle load —
     # hits (executables deserialized, never re-lowered) vs rebuilds
@@ -109,7 +116,7 @@ _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",
                 "instances_per_sec", "queue_ms", "latency_ms",
                 "device_ms", "latency_p50_ms", "latency_p99_ms",
                 "rows_per_sec", "gather_ms", "serialize_ms",
-                "write_ms", "fsync_ms")
+                "write_ms", "fsync_ms", "quantize_ms")
 
 # ratio fields must sit in [0, 1]
 _RATIO_KEYS = ("buffer_reuse_rate", "h2d_overlap_ratio", "fill_rate",

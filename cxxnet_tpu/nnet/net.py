@@ -302,7 +302,14 @@ class FuncNet:
             pkey = g.layer_key(g.param_layer_index(li))
             p = params.get(pkey, {})
             s = new_state.get(pkey, {})
-            if fold_eval and li in self._fold_pairs:
+            if fold_eval and li in self._fold_pairs \
+                    and "_fold_scale" not in p \
+                    and "_r_shift" not in p \
+                    and "_r_shift_relu" not in p:
+                # inject the fold scale/shift computed in-graph — UNLESS
+                # the frozen serve weight tree already carries them (or
+                # the pre-folded weight + effective shift) as leaves
+                # (trainer.freeze_serve_weights)
                 p = dict(p)
                 p.update(self._fold_entries(params, new_state, li))
             if li in self._depad_layers:

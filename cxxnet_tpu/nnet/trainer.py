@@ -109,6 +109,23 @@ class NetTrainer:
         self.quant_tables = {}           # quant/<layer> range arrays
         self.quant_meta = {}             # __meta__["quantized"]
         self.quant_report = {"active": False}
+        self.serve_weight_residency = 1  # 0: legacy per-dispatch weight
+        #                                  fold/quantize in the traced
+        #                                  eval graph; 1: fold+quantize
+        #                                  ONCE at load into a device-
+        #                                  resident serve weight tree
+        #                                  shared by every pred
+        #                                  executable (doc/serving.md
+        #                                  "Device memory accounting")
+        self.serve_device_mem_budget = 0.0  # MB; >0 rejects a model
+        #                                  whose resident weight bytes
+        #                                  exceed it (typed
+        #                                  ResidencyBudgetError, not an
+        #                                  OOM). 0 = unlimited
+        self.serve_donate = 1            # donate the pred data/mask
+        #                                  buffers to the serve-ladder
+        #                                  executables (XLA may reuse
+        #                                  them for outputs)
         self.input_layout = "none"       # rowmajor: pin the batch
         #                                  input's device layout with
         #                                  channels minor (lane dim) so
@@ -192,6 +209,12 @@ class NetTrainer:
             if name == "serve_dtype":
                 from .quantize import normalize_serve_dtype
                 self.serve_dtype = normalize_serve_dtype(val)
+            if name == "serve_weight_residency":
+                self.serve_weight_residency = int(val)
+            if name == "serve_device_mem_budget":
+                self.serve_device_mem_budget = float(val)
+            if name == "serve_donate":
+                self.serve_donate = int(val)
             if name in ("shard_optimizer", "update_on_server"):
                 # update_on_server=1 meant "optimizer state lives off the
                 # workers" (nnet_ps_server.cpp); here it means "optimizer
@@ -578,6 +601,14 @@ class NetTrainer:
 
         self._pred_step = jax.jit(pred_step,
                                   static_argnames=("nodes_wanted",))
+        # the serve-ladder variant donates the batch data/mask buffers
+        # (consumed exactly once per dispatch) so XLA may reuse them
+        # for outputs; compiled only by precompile_pred(donate=True) —
+        # results are identical, so the two variants are interchangeable
+        self._pred_step_donate = jax.jit(pred_step,
+                                         static_argnames=("nodes_wanted",),
+                                         donate_argnums=(2, 3))
+        self._build_resident_prep()
 
     def _probe_input_layout(self) -> None:
         """input_layout = rowmajor support probe: a tiny device_put
@@ -624,6 +655,210 @@ class NetTrainer:
         dll, layout = self._layout_cls
         return layout(dll(major_to_minor=tuple(range(ndim))), sharding)
 
+    # -- device-resident serve weights (doc/serving.md) ------------------
+
+    def _resident_plan(self) -> List[Dict[str, Any]]:
+        """Static per-layer plan of the eval-graph weight work that can
+        hoist out of the per-dispatch traced graph into a one-time
+        freeze: ``bn_fold_eval`` weight folds, int8/fp8 weight
+        quantization, bf16 weight casts, and the per-channel epilogue
+        vectors. Channel-alignment-annotated layers keep the legacy
+        in-graph path (channel_pad is a training-bench knob; serving
+        graphs run unpadded). Empty plan = the serve tree IS the master
+        tree (nothing to hoist, nothing extra resident)."""
+        net, g = self.net, self.graph
+        shared_primaries = set(info.primary_layer_index
+                               for info in g.layers
+                               if info.type == "share")
+        plan: List[Dict[str, Any]] = []
+        for li, info in enumerate(g.layers):
+            if info.type not in ("conv", "fullc") \
+                    or li in shared_primaries:
+                continue
+            lkey = g.layer_key(li)
+            if lkey not in self.params \
+                    or "wmat" not in self.params[lkey]:
+                continue
+            layer = net.layer_objs[li]
+            if (getattr(layer, "_in_layout", None) is not None
+                    or getattr(layer, "_out_pad", 0)
+                    or getattr(layer, "_layout", None) is not None):
+                continue
+            q = getattr(layer, "_quant", None)
+            quant = q is not None and q.is_affine
+            bf16 = (layer.param.compute_dtype == "bfloat16"
+                    or (q is not None and q.dtype == "bfloat16"))
+            fold = (info.type == "conv" and net._bn_fold_eval
+                    and li in net._fold_pairs)
+            # with conv_pallas_epilogue the fold factor applies to the
+            # conv OUTPUT (no per-dispatch weight work exists): only
+            # the scale/shift vectors precompute, the weight stays raw
+            epifold = (fold and not quant
+                       and bool(layer.param.conv_pallas_epilogue))
+            prefold = fold and not epifold
+            if not (quant or bf16 or prefold or epifold):
+                continue
+            relu = False
+            if fold:
+                relu = bool(net.layer_objs[net._fold_pairs[li]]
+                            .fuse_relu)
+            plan.append({"li": li, "lkey": lkey, "kind": info.type,
+                         "q": q, "quant": quant, "bf16": bf16,
+                         "prefold": prefold, "epifold": epifold,
+                         "relu": relu,
+                         "has_bias": layer.param.no_bias == 0})
+        return plan
+
+    def _build_resident_prep(self) -> None:
+        """The ONE-time serve-weight transformation program: folds,
+        quantizes and casts the eval weight tree on device at freeze
+        (registered in ``lint/config.py PROGRAM_BUILDERS``). Returns
+        only the NEW leaves — untransformed weights alias the masters
+        so they are never duplicated on device."""
+        self._serve_plan = self._resident_plan()
+        self._serve_prep = None
+        if not self._serve_plan:
+            return
+        net = self.net
+        plan = self._serve_plan
+
+        def prep(params, net_state):
+            out: Dict[str, Dict[str, Any]] = {}
+            for item in plan:
+                p = params[item["lkey"]]
+                new: Dict[str, Any] = {}
+                w = p["wmat"]
+                b = p.get("bias") if item["has_bias"] else None
+                eff = None
+                if item["prefold"] or item["epifold"]:
+                    fe = net._fold_entries(params, net_state,
+                                           item["li"])
+                    scale, shift = fe["_fold_scale"], fe["_fold_shift"]
+                    if item["prefold"]:
+                        w = w * scale
+                        eff = shift if b is None else shift + b * scale
+                    else:
+                        new["_fold_scale"] = scale
+                        new["_fold_shift"] = shift
+                        if item["relu"]:
+                            # value never read — key presence is the
+                            # (static) relu flag, as on the legacy path
+                            new["_fold_relu"] = jnp.ones((),
+                                                         jnp.float32)
+                if item["quant"]:
+                    q = item["q"]
+                    w = q.quantize_w(w)
+                    dq = q.dequant_vec()
+                    new["_r_dequant"] = dq
+                    if item["kind"] == "conv":
+                        shift_vec = eff if eff is not None \
+                            else (b if b is not None
+                                  else jnp.zeros_like(dq))
+                        new["_r_shift_relu" if item["relu"]
+                            else "_r_shift"] = shift_vec
+                elif item["prefold"]:
+                    new["_r_shift_relu" if item["relu"]
+                        else "_r_shift"] = eff
+                if item["bf16"] and not item["quant"]:
+                    w = w.astype(jnp.bfloat16)
+                if item["quant"] or item["prefold"] or item["bf16"]:
+                    new["wmat"] = w
+                out[item["lkey"]] = new
+            return out
+
+        self._serve_prep = jax.jit(prep)
+
+    def _predict_resident_extra(self) -> int:
+        """Bytes the serve tree will add beyond the masters, computed
+        from the plan WITHOUT touching the device — so a budget breach
+        rejects before the upload, not as an OOM during it."""
+        extra = 0
+        for item in self._serve_plan:
+            w = self.params[item["lkey"]]["wmat"]
+            n = int(np.prod(w.shape))
+            if item["quant"]:
+                extra += n if item["q"].native else 4 * n
+            elif item["bf16"]:
+                extra += 2 * n
+            elif item["prefold"]:
+                extra += 4 * n
+            # per-channel vectors are noise next to the weight tensors
+        return extra
+
+    def freeze_serve_weights(self, force: bool = False):
+        """Build (or return) the device-resident serve weight tree:
+        eval folds applied, int8/fp8 weights quantized, bf16 weights
+        cast — exactly once — and install it in the program registry
+        with honest byte accounting against
+        ``serve_device_mem_budget``. Every subsequent pred dispatch
+        passes the tree as arguments, so all bucket executables share
+        one copy per model. Returns the
+        :class:`~cxxnet_tpu.artifact.registry.WeightResidency` (None
+        when ``serve_weight_residency = 0``). Any weight mutation
+        (update/set_weight/copy_model_from/program rebuild) invalidates
+        the tree; the next pred dispatch re-freezes against the same
+        executables (identical avals — no recompile)."""
+        assert self._initialized, "call init_model/load_model first"
+        if not self.serve_weight_residency:
+            return None
+        reg = self.programs
+        if reg.residency is not None and not force:
+            return reg.residency
+        budget = int(self.serve_device_mem_budget * 1e6)
+
+        def tree_bytes(pytrees, seen):
+            tot = 0
+            for tr in pytrees:
+                for pt in tr.values():
+                    for v in pt.values():
+                        if id(v) in seen:
+                            continue
+                        seen.add(id(v))
+                        tot += int(getattr(v, "nbytes", 0) or 0)
+            return tot
+
+        seen: set = set()
+        master = tree_bytes((self.params, self.net_state), seen)
+        extra = self._predict_resident_extra()
+        if budget and master + extra > budget:
+            raise _areg.ResidencyBudgetError(
+                "model needs ~%d resident bytes (masters %d + serve "
+                "tree extra %d) but serve_device_mem_budget allows %d"
+                % (master + extra, master, extra, budget))
+        t0 = time.perf_counter()
+        if self._serve_prep is not None:
+            new = self._serve_prep(self.params, self.net_state)
+            jax.block_until_ready(new)
+            tree = {lk: ({**pt, **new[lk]} if lk in new else pt)
+                    for lk, pt in self.params.items()}
+        else:
+            tree = self.params
+        quantize_ms = (time.perf_counter() - t0) * 1e3
+        tb = tree_bytes((tree,), set())
+        # ``seen`` already holds every master buffer: only the leaves
+        # the prep program materialized add to the deduped total
+        total = master + tree_bytes((tree,), seen)
+        res = _areg.WeightResidency(
+            tree, tb, master, total, quantize_ms,
+            len(self._serve_plan), self.serve_dtype,
+            bool(self._serve_plan))
+        reg.install_weights(res, budget)
+        if self._mon_on():
+            self._mon.emit("weight_residency", **res.record())
+        return res
+
+    def _pred_operands(self):
+        """The (params, net_state) every eval/pred dispatch passes:
+        the device-resident serve tree under weight residency (frozen
+        lazily), the raw masters otherwise. One definition so
+        precompile keys and dispatch operands can never disagree on
+        the calling convention."""
+        if self.serve_weight_residency:
+            res = self.programs.residency or self.freeze_serve_weights()
+            if res is not None:
+                return res.tree, self.net_state
+        return self.params, self.net_state
+
     @property
     def _aot(self) -> Dict[tuple, Any]:
         """The registry's executable map — kept as a read surface for
@@ -657,11 +892,12 @@ class NetTrainer:
     pred_sig = staticmethod(_areg.pred_sig)
 
     def _call_pred(self, data, mask, extra, nodes_wanted):
+        params, net_state = self._pred_operands()
         sig = self.pred_sig(data.shape, data.dtype, mask is None,
                             len(extra), nodes_wanted)
         return self._call_step(
             "pred", sig, self._pred_step,
-            (self.params, self.net_state, data, mask, extra),
+            (params, net_state, data, mask, extra),
             nodes_wanted=nodes_wanted)
 
     # -- AOT precompile --------------------------------------------------
@@ -801,9 +1037,13 @@ class NetTrainer:
                 nodes = tuple(self._metric_nodes)
                 key = ("pred",) + self.pred_sig(
                     data_shape, dtype, mask_v is None, 0, nodes)
+                # operands resolved at lower time: under weight
+                # residency the eval dispatches pass the frozen serve
+                # tree, so the precompiled program must take the same
+                # pytree (one calling convention per trainer)
                 programs.append((key, lambda m=mask_v, nw=nodes:
                                  self._pred_step.lower(
-                                     self.params, self.net_state,
+                                     *self._pred_operands(),
                                      data_s, m, (),
                                      nodes_wanted=nw)))
 
@@ -852,7 +1092,7 @@ class NetTrainer:
 
     def precompile_pred(self, batch_sizes: Sequence[int],
                         nodes_wanted: Optional[Sequence[int]] = None,
-                        dtype=None) -> int:
+                        dtype=None, donate: bool = False) -> int:
         """AOT-compile the eval/pred forward at a set of batch-size
         buckets — the serve-engine warmup path (doc/serving.md).
 
@@ -882,6 +1122,14 @@ class NetTrainer:
         dt = np.dtype(np.float32 if dtype is None else dtype)
         inst = inst_array_shape(tuple(self.graph.input_shape))
         from ..serve.bucketing import reachable_variants
+        # one resolve up front: freezes the serve weight tree (weight
+        # residency on) so every bucket executable below is lowered
+        # against the SAME shared device tree — and a
+        # serve_device_mem_budget breach rejects here, at warmup, with
+        # the typed error instead of an OOM mid-request
+        params_t, state_t = self._pred_operands()
+        pred_jit = self._pred_step_donate \
+            if donate and self.serve_donate else self._pred_step
         programs = []
         data_structs = {}
         for n, rows in reachable_variants(batch_sizes):
@@ -895,10 +1143,10 @@ class NetTrainer:
                 (n,), np.float32, sharding=self._b_shard)
             key = ("pred",) + self.pred_sig(
                 data_shape, dt, mask_s is None, 0, nodes)
-            programs.append((key, lambda ds=data_structs[n], m=mask_s:
-                             self._pred_step.lower(
-                                 self.params, self.net_state, ds,
-                                 m, (), nodes_wanted=nodes)))
+            programs.append((key, lambda ds=data_structs[n], m=mask_s,
+                             pj=pred_jit:
+                             pj.lower(params_t, state_t, ds,
+                                      m, (), nodes_wanted=nodes)))
         compiled = self._compile_programs(programs,
                                           "precompile_pred_failed")
         if self._mon_on():
@@ -1213,6 +1461,8 @@ class NetTrainer:
             do_update=bool(do_update))
         (self.params, self.opt_state, self.net_state,
          self.grad_acc, loss, preds) = out
+        self.programs.residency = None   # weights moved: the frozen
+        #                                  serve tree is stale
         self._last_loss = loss
         ex = self._local_batch_size(batch) - batch.num_batch_padd
         self._count_examples(ex)
@@ -1261,6 +1511,7 @@ class NetTrainer:
              self._step_scalar(), self._base_key))
         (self.params, self.opt_state, self.net_state, self.grad_acc,
          loss) = out
+        self.programs.residency = None
         self._last_loss = loss
         ex = (self._local_batch_size(batch) - batch.num_batch_padd) * n
         self._count_examples(ex)
@@ -1324,6 +1575,7 @@ class NetTrainer:
             collect=collect)
         (self.params, self.opt_state, self.net_state, self.grad_acc,
          loss, preds_k) = out
+        self.programs.residency = None
         self._last_loss = loss
         ex = sum(self._local_batch_size(b) - b.num_batch_padd
                  for b in batches)
@@ -1482,6 +1734,7 @@ class NetTrainer:
                                 self._p_shard[layer_name][tag])
         p[layer_name] = lp
         self.params = p
+        self.programs.residency = None   # frozen serve tree is stale
 
     @staticmethod
     def _to_ref_layout(w: np.ndarray) -> np.ndarray:
@@ -1645,6 +1898,14 @@ class NetTrainer:
         from ..artifact.bundle import runtime_fingerprint
         fp_ok = bundle.manifest.get("fingerprint") \
             == runtime_fingerprint(self.mesh)
+        # the sealed executables' weight calling convention must match
+        # this trainer's: a residency-sealed pred takes the frozen
+        # serve tree as arguments, a legacy one the raw masters — a
+        # mismatch would call an executable with the wrong pytree, so
+        # it downgrades to the per-key re-lower fallback instead
+        if int(bundle.manifest.get("weight_residency", 0)) \
+                != int(bool(self.serve_weight_residency)):
+            fp_ok = False
         rep = self.programs.install_serialized(
             bundle.programs, bundle.path, fp_ok, monitor=self._mon)
         if self._mon_on():
@@ -1676,6 +1937,7 @@ class NetTrainer:
         if self.silent == 0 and copied:
             print("copy_model_from: copied layers %s" % ", ".join(copied))
         self._put_all()
+        self.programs.residency = None   # frozen serve tree is stale
 
     @property
     def last_loss(self) -> float:

@@ -82,10 +82,13 @@ class _Request:
 class DynamicBatcher:
     """Coalesce request rows into bucketed micro-batches.
 
-    ``stage_fn(rows) -> staged`` issues the H2D transfer (cheap, async);
-    ``dispatch_fn(staged) -> np.ndarray`` runs the executable and
-    returns one output row per input row. The split exists so the two
-    halves can overlap across consecutive batches.
+    ``stage_fn(rows) -> staged`` issues the H2D transfer (cheap,
+    async); it receives ONE row array for a single-request batch and a
+    LIST of per-request row arrays for a coalesced one (so an engine
+    with a preallocated staging ring assembles client rows in a single
+    copy). ``dispatch_fn(staged) -> np.ndarray`` runs the executable
+    and returns one output row per input row. The split exists so the
+    two halves can overlap across consecutive batches.
     """
 
     def __init__(self, stage_fn: Callable[[np.ndarray], Any],
@@ -244,9 +247,14 @@ class DynamicBatcher:
             if not batch:
                 continue
             try:
-                rows = batch[0].rows if len(batch) == 1 \
-                    else np.concatenate([r.rows for r in batch], axis=0)
-                staged = self._stage_fn(rows)
+                # a multi-request batch hands the per-request row
+                # arrays straight to stage: the engine assembles them
+                # into its preallocated staging buffer in ONE copy
+                # (client array -> H2D source) instead of paying a
+                # concatenate copy first
+                staged = self._stage_fn(
+                    batch[0].rows if len(batch) == 1
+                    else [r.rows for r in batch])
             except Exception as e:
                 self._fail_batch(batch, e, t_form=now)
                 continue

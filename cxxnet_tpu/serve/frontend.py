@@ -216,6 +216,7 @@ class FleetConfig:
         self.host = "127.0.0.1"
         self.swap_poll_s = 2.0
         self.duration_s = 0.0
+        self.mem_budget_mb = 0.0
         model_dir, model_in = "./models", ""
         for name, val in cfg:
             if name == "serve_models":
@@ -230,6 +231,8 @@ class FleetConfig:
                 self.swap_poll_s = float(val)
             if name == "serve_fleet_duration_s":
                 self.duration_s = float(val)
+            if name == "serve_device_mem_budget":
+                self.mem_budget_mb = float(val)
             if name == "model_dir":
                 model_dir = val
             if name == "model_in":
@@ -282,7 +285,11 @@ class FleetServer:
         self.cfg = list(cfg)
         self.fleet_cfg = FleetConfig(self.cfg)
         self.quota = QuotaManager(self.cfg)
-        self.router = ModelRouter()
+        # fleet-wide device-memory accounting: the router rejects a
+        # register/swap whose resident weight bytes would blow the
+        # budget (typed error, old model set keeps serving)
+        self.router = ModelRouter(
+            mem_budget_bytes=int(self.fleet_cfg.mem_budget_mb * 1e6))
         self._mon = monitor
         self._closing = False
         self._closed = False
@@ -450,6 +457,9 @@ class FleetServer:
                 "row_elems": int(np.prod(inst)),
                 "instance_shape": list(inst),
                 "buckets": list(e.session.engine.buckets),
+                # per-model device-memory accounting (doc/serving.md
+                # "Device memory accounting")
+                "device_mem_bytes": e.resident_bytes,
             })
         return out
 

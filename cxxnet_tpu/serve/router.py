@@ -23,16 +23,31 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List, Optional
 
+from ..artifact.registry import ResidencyBudgetError
+
 
 class UnknownModelError(KeyError):
     """Request named a model id the router does not serve."""
 
 
+def session_resident_bytes(session) -> int:
+    """A session's device-resident weight bytes (the model's frozen
+    serve weight tree + retained masters, buffer-deduplicated) — 0
+    when the engine runs without weight residency accounting."""
+    try:
+        res = session.engine.trainer.programs.residency
+    except AttributeError:
+        return 0
+    return int(res.total_bytes) if res is not None else 0
+
+
 class ModelEntry:
     """One routed model: the live session plus the provenance the
-    hot-swap watcher compares against (snapshot counter + path)."""
+    hot-swap watcher compares against (snapshot counter + path) and
+    its device-memory accounting."""
 
-    __slots__ = ("model_id", "session", "counter", "path", "generation")
+    __slots__ = ("model_id", "session", "counter", "path", "generation",
+                 "resident_bytes")
 
     def __init__(self, model_id: str, session, counter: int, path: str,
                  generation: int = 0):
@@ -41,6 +56,7 @@ class ModelEntry:
         self.counter = counter
         self.path = path
         self.generation = generation
+        self.resident_bytes = session_resident_bytes(session)
 
 
 class ModelRouter:
@@ -48,13 +64,42 @@ class ModelRouter:
 
     The first registered model is the default (requests that name no
     model id route there). ``close_all`` drains every entry — the
-    front-end shutdown path."""
+    front-end shutdown path.
 
-    def __init__(self):
+    ``mem_budget_bytes`` (0 = unlimited) makes multi-model co-location
+    memory-honest: a ``register`` or ``swap`` whose per-model resident
+    weight bytes would push the fleet total over the budget raises the
+    typed :class:`~cxxnet_tpu.artifact.registry.ResidencyBudgetError`
+    — the table is untouched, so whatever was serving keeps serving
+    (the hot-swap watcher treats it like any failed flip and discards
+    the shadow session)."""
+
+    def __init__(self, mem_budget_bytes: int = 0):
         self._lock = threading.Lock()
         self._models: Dict[str, ModelEntry] = {}
         self._order: List[str] = []
         self._closed = False
+        self.mem_budget_bytes = int(mem_budget_bytes)
+
+    def _check_budget(self, entry: ModelEntry,
+                      replacing: Optional[str] = None) -> None:
+        """Called under the lock: would installing ``entry`` (in place
+        of ``replacing``) blow the budget?"""
+        if not self.mem_budget_bytes:
+            return
+        total = entry.resident_bytes + sum(
+            e.resident_bytes for m, e in self._models.items()
+            if m != replacing)
+        if total > self.mem_budget_bytes:
+            raise ResidencyBudgetError(
+                "loading model %r (%d resident bytes) would put the "
+                "fleet at %d bytes, over serve_device_mem_budget (%d)"
+                % (entry.model_id, entry.resident_bytes, total,
+                   self.mem_budget_bytes))
+
+    def resident_bytes_total(self) -> int:
+        with self._lock:
+            return sum(e.resident_bytes for e in self._models.values())
 
     # -- registration -----------------------------------------------------
 
@@ -65,6 +110,7 @@ class ModelRouter:
                 raise ValueError("model %r already registered"
                                  % model_id)
             entry = ModelEntry(model_id, session, counter, path)
+            self._check_budget(entry)
             self._models[model_id] = entry
             self._order.append(model_id)
             return entry
@@ -101,7 +147,8 @@ class ModelRouter:
         """Model table for the HTTP ``/v1/models`` endpoint."""
         with self._lock:
             return [{"model": e.model_id, "counter": e.counter,
-                     "path": e.path, "generation": e.generation}
+                     "path": e.path, "generation": e.generation,
+                     "device_mem_bytes": e.resident_bytes}
                     for e in (self._models[m] for m in self._order)]
 
     # -- hot swap ---------------------------------------------------------
@@ -123,9 +170,14 @@ class ModelRouter:
             if old is None:
                 raise UnknownModelError(
                     "cannot swap unregistered model %r" % model_id)
-            self._models[model_id] = ModelEntry(
-                model_id, session, counter, path,
-                generation=old.generation + 1)
+            entry = ModelEntry(model_id, session, counter, path,
+                               generation=old.generation + 1)
+            # steady-state accounting: the retired entry's bytes free
+            # once it drains, so the budget compares against the
+            # post-swap set (the shadow-build window transiently holds
+            # both — documented in doc/serving.md)
+            self._check_budget(entry, replacing=model_id)
+            self._models[model_id] = entry
             return old
 
     # -- shutdown ---------------------------------------------------------

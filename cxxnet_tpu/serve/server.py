@@ -114,8 +114,16 @@ class ServeSession:
         # one snapshot: compile_events and aot_hits must come from the
         # same instant in the emitted serve_summary record
         snap = self.engine.counters_snapshot()
+        res = self.engine.trainer.programs.residency
         return {"compile_events": snap["compile_events"],
-                "aot_hits": snap["aot_hits"]}
+                "aot_hits": snap["aot_hits"],
+                # zero-copy dispatch accounting: bytes that actually
+                # crossed D2H (valid rows only) and the staging-ring
+                # reuse split (doc/serving.md)
+                "d2h_bytes": snap["d2h_bytes"],
+                "staging_reuse": snap["staging_reuse"],
+                "staging_alloc": snap["staging_alloc"],
+                "resident_bytes": res.total_bytes if res else 0}
 
     def submit(self, rows: np.ndarray,
                timeout_ms: Optional[float] = None):

@@ -81,13 +81,20 @@ def test_pad_to_bucket():
 # -- dynamic batcher over a fake engine (no jax) ------------------------
 
 
+def _stage_rows(rows):
+    """The stage_fn row contract: one array for a single-request
+    batch, a list of per-request arrays for a coalesced one."""
+    return np.concatenate(rows, axis=0) if isinstance(rows, list) \
+        else rows
+
+
 def _echo_batcher(monitor=None, **kw):
     """Batcher whose 'engine' is the identity: stage passes rows
     through, dispatch returns them — per-request row routing and every
     concurrency semantic are exercised without a device."""
     kw.setdefault("max_batch", 4)
     kw.setdefault("max_delay_ms", 2.0)
-    return DynamicBatcher(lambda rows: rows, lambda staged: staged,
+    return DynamicBatcher(_stage_rows, lambda staged: staged,
                           monitor=monitor, **kw)
 
 
@@ -160,7 +167,7 @@ def test_batcher_backpressure_rejects_when_queue_full():
         gate.wait(10)
         return rows
 
-    b = DynamicBatcher(lambda r: r, blocked_dispatch, max_batch=1,
+    b = DynamicBatcher(_stage_rows, blocked_dispatch, max_batch=1,
                        max_delay_ms=0.0, max_queue_rows=2,
                        stage_depth=1, monitor=Monitor(sink))
     futs, saw_busy = [], False
@@ -205,7 +212,7 @@ def test_batcher_propagates_engine_errors_and_keeps_serving():
             raise ValueError("poisoned batch")
         return rows
 
-    b = DynamicBatcher(lambda r: r, dispatch, max_batch=4,
+    b = DynamicBatcher(_stage_rows, dispatch, max_batch=4,
                        max_delay_ms=1.0)
     bad = b.submit(np.full((2, 2), np.nan, np.float32))
     with pytest.raises(ValueError, match="poisoned"):
@@ -224,7 +231,7 @@ def test_batcher_graceful_drain_completes_queued_work():
         done.append(rows.shape[0])
         return rows
 
-    b = DynamicBatcher(lambda r: r, slow_dispatch, max_batch=4,
+    b = DynamicBatcher(_stage_rows, slow_dispatch, max_batch=4,
                        max_delay_ms=1.0, max_queue_rows=100)
     futs = [b.submit(np.full((1, 2), i, np.float32))
             for i in range(20)]
@@ -240,7 +247,7 @@ def test_batcher_graceful_drain_completes_queued_work():
 
 def test_batcher_close_without_drain_fails_pending():
     gate = threading.Event()
-    b = DynamicBatcher(lambda r: r, lambda r: (gate.wait(10), r)[1],
+    b = DynamicBatcher(_stage_rows, lambda r: (gate.wait(10), r)[1],
                        max_batch=1, max_delay_ms=0.0,
                        max_queue_rows=100, stage_depth=1)
     futs = [b.submit(np.full((1, 2), i, np.float32))

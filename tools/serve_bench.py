@@ -76,6 +76,9 @@ def build_session(args, monitor, via: str = ""):
     ]
     if args.serve_dtype:
         serve_pairs.append(("serve_dtype", args.serve_dtype))
+    if args.serve_weight_residency:
+        serve_pairs.append(("serve_weight_residency",
+                            args.serve_weight_residency))
     if args.artifact and via != "snapshot":
         # conf-less boot: the serve contract (bucket ladder, dtype,
         # node, max batch) comes from the sealed manifest; explicit
@@ -142,6 +145,15 @@ def sweep_point(args, clients, monitor, sink):
                     args.peak_tflops)
     if mfu is not None:
         pt["mfu"] = mfu
+    if args.device_mem:
+        # per-model resident device bytes from the weight_residency
+        # record the freeze emitted during this point's session build
+        res = [r for r in sink.records
+               if r["event"] == "weight_residency"]
+        pt["device_mem_bytes"] = res[-1]["bytes"] if res else 0
+        if res:
+            pt["residency_quantize_ms"] = round(
+                res[-1]["quantize_ms"], 3)
     return pt
 
 
@@ -419,6 +431,19 @@ def main(argv=None) -> int:
                          "quantized --artifact); the record is "
                          "dtype-tagged. Default: the artifact's "
                          "sealed dtype, else float32")
+    ap.add_argument("--device-mem", action="store_true",
+                    help="add a device-memory-per-model column to "
+                         "every sweep point (resident bytes from the "
+                         "weight_residency record) and exit 3 if "
+                         "resident bytes GROW across sweep points — "
+                         "a weight-residency leak guard")
+    ap.add_argument("--serve-weight-residency", default="",
+                    choices=["", "0", "1"],
+                    help="force serve_weight_residency for the sweep "
+                         "(default: the config/trainer default, 1) — "
+                         "0 gives the legacy per-dispatch "
+                         "fold/quantize baseline for before/after "
+                         "records")
     ap.add_argument("--peak-tflops", type=float, default=0.0,
                     help="chip peak TFLOP/s for the serve dtype; when "
                          "set, every sweep point carries an MFU column "
@@ -513,12 +538,26 @@ def main(argv=None) -> int:
     }
     if cold_start is not None:
         rec["cold_start"] = cold_start
+    if args.serve_weight_residency:
+        rec["weight_residency"] = int(args.serve_weight_residency)
     out = json.dumps(rec, sort_keys=True)
     print(out)
     if args.out:
         with open(args.out, "w") as f:
             f.write(out + "\n")
-    return 0 if rec["zero_recompiles"] else 1
+    if not rec["zero_recompiles"]:
+        return 1
+    if args.device_mem:
+        # leak guard: every sweep point boots a FRESH session of the
+        # same model, so its resident bytes must not grow point over
+        # point — growth means freeze-time buffers leak across boots
+        mem = [p["device_mem_bytes"] for p in points
+               if p.get("device_mem_bytes")]
+        if any(b > a for a, b in zip(mem, mem[1:])):
+            print("# residency leak: resident bytes grew across "
+                  "sweep points: %s" % mem, file=sys.stderr)
+            return 3
+    return 0
 
 
 if __name__ == "__main__":
