@@ -166,6 +166,16 @@ def runtime_fingerprint(mesh=None) -> Dict[str, Any]:
     return fp
 
 
+def fingerprint_sha(fp: Dict[str, Any]) -> str:
+    """Stable short hash of a runtime fingerprint dict — the identity
+    operators and the canary comparator use to tell which runtime an
+    engine's executables were built for (``/v1/models``,
+    doc/serving.md "Horizontal fleet"). Sorted-key JSON so dict order
+    never changes the hash."""
+    blob = json.dumps(fp, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
 # -- export ---------------------------------------------------------------
 
 

@@ -1,0 +1,37 @@
+"""Horizontal fleet: replica balancer, telemetry-driven autoscale,
+canary rollout (``task = fleet``, doc/serving.md "Horizontal fleet").
+
+The tier above the serve core that turns N shared-nothing
+``serve_fleet`` replica **processes** into one elastic, self-healing
+service:
+
+- :mod:`~cxxnet_tpu.fleet.balancer` — front-of-fleet routing over
+  both existing protocols: load-aware health (enriched ``/healthz``),
+  idempotent retry across a replica loss (zero dropped requests),
+  fleet-wide tenant quotas, canary traffic pinning;
+- :mod:`~cxxnet_tpu.fleet.replica` — replica process lifecycle:
+  spawn through the standard CLI, learn ephemeral ports via
+  ``serve_port_file``, graceful drain/stop;
+- :mod:`~cxxnet_tpu.fleet.controller` — the autoscaler: classify
+  load from the balancer's telemetry window (queue depth, shed rate,
+  p99 vs SLO), scale out from the same sealed bundle (near-zero cold
+  start is what makes elasticity cheap), drain in at idle, self-heal
+  crashed replicas;
+- :mod:`~cxxnet_tpu.fleet.canary` — one-shot canary rollout: pin a
+  fraction, compare per-version windows, promote or roll back with a
+  schema-validated decision record.
+"""
+
+from .balancer import (FleetBalancer, ReplicaState,
+                       ReplicaUnreachable)
+from .canary import CanaryRollout, canary_decision
+from .config import FleetTierConfig, models_spec, version_of
+from .controller import FleetController, classify_load
+from .replica import ReplicaManager, ReplicaProcess, SpawnError
+
+__all__ = [
+    "FleetBalancer", "ReplicaState", "ReplicaUnreachable",
+    "CanaryRollout", "canary_decision", "FleetTierConfig",
+    "models_spec", "version_of", "FleetController", "classify_load",
+    "ReplicaManager", "ReplicaProcess", "SpawnError",
+]

@@ -1,0 +1,689 @@
+"""Front-of-fleet balancer: one address over N shared-nothing replicas.
+
+The :class:`FleetBalancer` speaks both existing protocols (HTTP/JSON
+and the CXN1 binary frames — the frame grammar and status vocabulary
+are imported from ``serve/frontend.py``, so every existing client
+works unchanged) and routes each request to a replica process:
+
+- **load-aware health routing** — a poller thread reads every
+  replica's enriched ``GET /healthz`` (queued rows, cumulative
+  request/shed/error counters, p99, resident bytes) on a fixed
+  cadence; request placement picks the ready, non-draining replica
+  with the least (in-flight + queued) load. A replica that fails
+  ``fleet_unhealthy_after`` consecutive polls — or any forward
+  attempt at transport level — is routed around until a poll
+  succeeds again.
+- **idempotent retries** — ``predict`` is pure, so a transport
+  failure (connection refused/reset, torn reply: the signature of a
+  replica dying mid-request) retries the SAME rows on another replica,
+  excluding the failed one. Losing a replica mid-traffic therefore
+  drops **zero** requests (pinned by tests and the
+  ``serve_bench --replicas`` kill scenario). A ``closed`` reply
+  (replica draining) retries the same way; a ``busy`` reply retries
+  once on a less-loaded replica before shedding.
+- **fleet-wide tenant quotas** — the per-tenant token buckets
+  (``serve_quota``/``serve_quota_default``) are enforced HERE, before
+  any replica queue; replicas are spawned with quotas stripped so one
+  tenant's contract is one bucket across the whole fleet, not N.
+- **canary pinning** — ``pin_canary(version, fraction)`` routes a
+  deterministic fraction of requests to replicas of that version;
+  per-version outcome/latency windows feed the canary comparator
+  (``fleet/canary.py``).
+
+Every request emits a schema-validated ``fleet_route`` record
+(replica, version, retries); quota sheds also emit ``tenant_shed``.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..monitor import LatencyHistogram, SafeEmitter
+from ..serve.frontend import (_BinaryHandler, _FleetBinaryServer,
+                              _FleetHTTPServer, _HttpHandler,
+                              HTTP_STATUS, BinaryClient)
+from ..serve.quota import QuotaManager, TenantQuotaError
+from .config import FleetTierConfig
+
+
+class ReplicaUnreachable(IOError):
+    """Transport-level forward failure: the replica is gone or the
+    connection died mid-exchange. Requests are idempotent, so the
+    caller retries on another replica."""
+
+
+class ReplicaState:
+    """Balancer-side view of one replica endpoint. ``inflight`` and
+    the flags are guarded by the balancer's table lock; the connection
+    pool has its own leaf lock (socket I/O must not hold the table
+    lock)."""
+
+    def __init__(self, replica_id: str, host: str, http_port: int,
+                 binary_port: int, version: str,
+                 kind: str = "baseline"):
+        self.replica_id = replica_id
+        self.host = host
+        self.http_port = http_port
+        self.binary_port = binary_port
+        self.version = version
+        self.kind = kind
+        self.ready = True
+        self.draining = False
+        self.suspect = False
+        self.suspect_since = 0.0
+        self.fail_polls = 0
+        self.inflight = 0
+        self.health: Dict[str, Any] = {}
+        self._pool: List[BinaryClient] = []
+        self._pool_lock = threading.Lock()
+
+    # -- connection pool (persistent binary connections) -----------------
+
+    def acquire(self, timeout: float) -> BinaryClient:
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+        return BinaryClient(self.host, self.binary_port,
+                            timeout=timeout)
+
+    def release(self, client: BinaryClient) -> None:
+        with self._pool_lock:
+            self._pool.append(client)
+
+    def close_pool(self) -> None:
+        with self._pool_lock:
+            clients, self._pool = self._pool, []
+        for c in clients:
+            try:
+                c.close()
+            except OSError:
+                pass  # cxxlint: disable=CXL006 -- teardown of a possibly-dead socket; there is nothing to do with a close error
+
+    def describe(self) -> Dict[str, Any]:
+        return {"replica": self.replica_id, "version": self.version,
+                "kind": self.kind, "ready": self.ready,
+                "draining": self.draining, "suspect": self.suspect,
+                "inflight": self.inflight,
+                "queue_rows": self.health.get("queue_rows", 0),
+                "p99_ms": self.health.get("p99_ms", 0.0),
+                "resident_bytes": self.health.get("resident_bytes",
+                                                  0)}
+
+
+class _VersionStats:
+    """Per-bundle-version outcome window (canary comparison)."""
+
+    __slots__ = ("ok", "errors", "lat")
+
+    def __init__(self):
+        self.ok = 0
+        self.errors = 0
+        self.lat = LatencyHistogram()
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"ok": self.ok, "errors": self.errors,
+                "requests": self.ok + self.errors,
+                "p99_ms": round(self.lat.percentile(0.99), 3),
+                "p50_ms": round(self.lat.percentile(0.50), 3)}
+
+
+class FleetBalancer:
+    """N replica endpoints behind the two protocol listeners.
+
+    Build from the parsed tier config plus the raw config stream (for
+    the quota grammar); ``start()`` binds listeners and the health
+    poller, ``close()`` stops them. Replica registration is the
+    controller's job (``add_replica`` / ``drain_replica`` /
+    ``remove_replica``)."""
+
+    # forward socket timeout: generous enough for a queued request on
+    # a loaded replica, finite so a wedged replica turns into a
+    # retryable transport error instead of a hung client
+    FORWARD_TIMEOUT_S = 60.0
+
+    def __init__(self, tier: FleetTierConfig, cfg=(), monitor=None):
+        self.tier = tier
+        self.quota = QuotaManager(cfg)
+        self._mon = monitor
+        self._safe_emit = SafeEmitter(monitor, "cxxnet_tpu fleet")
+        self._lock = threading.Lock()        # replica table
+        self._reps: Dict[str, ReplicaState] = {}
+        self._stats = threading.Lock()       # counters + windows
+        self.counters: Dict[str, int] = {
+            "requests": 0, "ok": 0, "shed": 0, "errors": 0,
+            "retries": 0, "unrouted": 0}
+        self._win = {"requests": 0, "ok": 0, "shed": 0, "errors": 0}
+        self._win_lat = LatencyHistogram()
+        self._win_t0 = time.monotonic()
+        self._versions: Dict[str, _VersionStats] = {}
+        self._pin_version: Optional[str] = None
+        self._pin_fraction = 0.0
+        self._pick_seq = 0
+        self._closing = False
+        self._http_server = None
+        self._binary_server = None
+        self._threads: List[threading.Thread] = []
+        self._poll_stop = threading.Event()
+        self.http_port = -1
+        self.binary_port = -1
+
+    # -- replica table ----------------------------------------------------
+
+    def add_replica(self, replica_id: str, host: str, http_port: int,
+                    binary_port: int, version: str,
+                    kind: str = "baseline") -> ReplicaState:
+        rep = ReplicaState(replica_id, host, http_port, binary_port,
+                           version, kind)
+        with self._lock:
+            if replica_id in self._reps:
+                raise ValueError("replica %r already registered"
+                                 % replica_id)
+            self._reps[replica_id] = rep
+        return rep
+
+    def remove_replica(self, replica_id: str) -> None:
+        with self._lock:
+            rep = self._reps.pop(replica_id, None)
+        if rep is not None:
+            rep.close_pool()
+
+    def drain_replica(self, replica_id: str,
+                      timeout_s: float = 30.0) -> bool:
+        """Stop routing new requests to the replica, then wait for its
+        in-flight forwards to finish — the zero-drop half of scale-in.
+        Returns False if in-flight work remained at the timeout."""
+        with self._lock:
+            rep = self._reps.get(replica_id)
+            if rep is None:
+                return True
+            rep.draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if rep.inflight == 0:
+                    return True
+            time.sleep(0.01)
+        with self._lock:
+            return rep.inflight == 0
+
+    def suspect_overdue(self, deadline_s: float) -> List[str]:
+        """Replicas that have been suspect (failing polls / transport)
+        for longer than ``deadline_s`` — alive-but-wedged processes
+        the controller must reap, or they would hold a fleet slot
+        forever while serving nothing."""
+        now = time.monotonic()
+        with self._lock:
+            return [r.replica_id for r in self._reps.values()
+                    if r.suspect and r.suspect_since
+                    and now - r.suspect_since >= deadline_s]
+
+    def replica_ids(self, kind: Optional[str] = None,
+                    version: Optional[str] = None) -> List[str]:
+        with self._lock:
+            return [r.replica_id for r in self._reps.values()
+                    if (kind is None or r.kind == kind)
+                    and (version is None or r.version == version)]
+
+    def describe_replicas(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.describe() for r in self._reps.values()]
+
+    # -- canary pinning ----------------------------------------------------
+
+    def pin_canary(self, version: str, fraction: float) -> None:
+        """Route ``fraction`` of requests to replicas serving
+        ``version`` (deterministic interleave, no RNG: request k goes
+        canary iff floor(k*f) advanced). Also resets the per-version
+        windows so the comparison covers exactly the pinned period."""
+        with self._stats:
+            self._versions = {}
+        with self._lock:
+            self._pin_version = version
+            self._pin_fraction = float(fraction)
+            self._pick_seq = 0
+
+    def unpin_canary(self) -> None:
+        with self._lock:
+            self._pin_version = None
+            self._pin_fraction = 0.0
+
+    def set_replica_kind(self, replica_id: str, kind: str) -> None:
+        """Reclassify a replica (a promoted canary joins the baseline
+        pool the autoscaler manages)."""
+        with self._lock:
+            rep = self._reps.get(replica_id)
+            if rep is not None:
+                rep.kind = kind
+
+    def version_stats(self) -> Dict[str, Dict[str, Any]]:
+        with self._stats:
+            return {v: s.snapshot()
+                    for v, s in self._versions.items()}
+
+    # -- the request path --------------------------------------------------
+
+    def handle(self, model_id: str, tenant: str, rows,
+               protocol: str = "http",
+               timeout_ms: Optional[float] = None
+               ) -> Tuple[str, Any, Dict[str, Any]]:
+        """Quota -> pick replica -> forward (with idempotent retry).
+        Same contract as ``FleetServer.handle`` — never raises, so
+        both protocol handlers plug in unchanged."""
+        t0 = time.monotonic()
+        nrows = 0
+        replica_id, version, retries = "", "", 0
+        try:
+            arr = np.asarray(rows, dtype=np.float32)  # cxxlint: disable=CXL003 -- protocol decode on the network tier: client rows arrive as host bytes/JSON lists, there is no device value to keep resident
+            if arr.ndim == 0:
+                raise ValueError("rows must be an array, got a scalar")
+            nrows = int(arr.shape[0]) if arr.ndim > 1 else 1
+            try:
+                self.quota.admit(tenant, nrows)
+            except TenantQuotaError as e:
+                self._emit("tenant_shed", tenant=tenant,
+                           model=model_id, rows=nrows, rate=e.rate,
+                           burst=e.burst,
+                           retry_after_s=round(e.retry_after_s, 3))
+                raise
+            status, result, extra, replica_id, version, retries = \
+                self._route(model_id, tenant, arr, timeout_ms)
+        except TenantQuotaError as e:
+            status, result = "over_quota", str(e)
+            extra = {"retry_after_s": e.retry_after_s}
+        except (ValueError, TypeError) as e:
+            status, result, extra = "bad_request", str(e), {}
+        except Exception as e:   # a balancer bug must answer, not hang
+            status, result, extra = "error", str(e), {}
+        self._record(protocol, status, model_id, tenant, nrows,
+                     replica_id, version, retries, t0)
+        return status, result, extra
+
+    def _route(self, model_id: str, tenant: str, arr: np.ndarray,
+               timeout_ms: Optional[float]):
+        excluded: set = set()
+        retries = 0
+        last: Optional[Tuple[str, Any, str, str]] = None
+        for attempt in range(self.tier.retries + 1):
+            rep = self._pick(excluded)
+            if rep is None:
+                break
+            with self._lock:
+                rep.inflight += 1
+            try:
+                status, result = self._forward(rep, model_id, tenant,
+                                               arr, timeout_ms)
+            except ReplicaUnreachable:
+                # the replica died (or its socket did) mid-request:
+                # mark it suspect so new requests route around it, and
+                # retry these idempotent rows elsewhere
+                with self._lock:
+                    if not rep.suspect:
+                        rep.suspect = True
+                        rep.suspect_since = time.monotonic()
+                excluded.add(rep.replica_id)
+                retries += 1
+                continue
+            finally:
+                with self._lock:
+                    rep.inflight -= 1
+            if status == "closed" and not self._closing:
+                # replica draining/shut down between pick and forward
+                excluded.add(rep.replica_id)
+                retries += 1
+                last = (status, result, rep.replica_id, rep.version)
+                continue
+            if status == "busy" and attempt == 0 \
+                    and self._ready_count() > 1:
+                # one overloaded replica is not fleet overload: give a
+                # less-loaded replica one chance before shedding
+                excluded.add(rep.replica_id)
+                retries += 1
+                last = (status, result, rep.replica_id, rep.version)
+                continue
+            return status, result, {}, rep.replica_id, rep.version, \
+                retries
+        if last is not None:
+            status, result, rid, ver = last
+            return status, result, {}, rid, ver, retries
+        with self._stats:
+            self.counters["unrouted"] += 1
+        return ("closed", "no ready replicas", {}, "", "", retries)
+
+    def _ready_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._reps.values()
+                       if r.ready and not r.draining
+                       and not r.suspect)
+
+    def _pick(self, excluded: set) -> Optional[ReplicaState]:
+        """Least-loaded ready replica (in-flight forwards + last
+        polled queue depth), honoring the canary pin."""
+        with self._lock:
+            cands = [r for r in self._reps.values()
+                     if r.ready and not r.draining and not r.suspect
+                     and r.replica_id not in excluded]
+            if not cands:
+                # desperation pass: every healthy replica is excluded
+                # or suspect — a suspect replica may have recovered,
+                # and answering beats returning "no replicas"
+                cands = [r for r in self._reps.values()
+                         if r.ready and not r.draining
+                         and r.replica_id not in excluded]
+            if not cands:
+                return None
+            if self._pin_version is not None:
+                self._pick_seq += 1
+                f = self._pin_fraction
+                want_canary = (math.floor(self._pick_seq * f)
+                               > math.floor((self._pick_seq - 1) * f))
+                pool = [r for r in cands
+                        if (r.version == self._pin_version)
+                        == want_canary]
+                if pool:
+                    cands = pool
+            return min(cands, key=lambda r: (
+                r.inflight + r.health.get("queue_rows", 0),
+                r.replica_id))
+
+    def _forward(self, rep: ReplicaState, model_id: str, tenant: str,
+                 arr: np.ndarray,
+                 timeout_ms: Optional[float]) -> Tuple[str, Any]:
+        """One binary-protocol exchange with the replica over a pooled
+        persistent connection. Any socket/framing failure raises
+        :class:`ReplicaUnreachable` (connection discarded)."""
+        # a client that declared a deadline LONGER than the default
+        # forward timeout gets the socket window to match — otherwise
+        # a legitimately slow request could never succeed through the
+        # balancer and would burn duplicate device work via retries
+        sock_timeout = self.FORWARD_TIMEOUT_S
+        if timeout_ms:
+            sock_timeout = max(sock_timeout, timeout_ms / 1e3 + 5.0)
+        try:
+            client = rep.acquire(sock_timeout)
+        except OSError as e:
+            raise ReplicaUnreachable(
+                "replica %s unreachable: %s" % (rep.replica_id, e))
+        try:
+            client.sock.settimeout(sock_timeout)
+            status, result = client.predict(
+                arr, model=model_id, tenant=tenant,
+                timeout_ms=timeout_ms if timeout_ms else 0.0)
+        except OSError as e:
+            try:
+                client.close()
+            except OSError:
+                pass  # cxxlint: disable=CXL006 -- the transport already failed; close is best-effort cleanup
+            raise ReplicaUnreachable(
+                "replica %s failed mid-request: %s"
+                % (rep.replica_id, e))
+        rep.release(client)
+        return status, result
+
+    # -- telemetry / accounting -------------------------------------------
+
+    def _emit(self, kind: str, **fields) -> None:
+        self._safe_emit(kind, **fields)
+
+    def _record(self, protocol: str, status: str, model: str,
+                tenant: str, rows: int, replica_id: str, version: str,
+                retries: int, t0: float) -> None:
+        latency_s = time.monotonic() - t0
+        shed = status in ("busy", "over_quota")
+        with self._stats:
+            self.counters["requests"] += 1
+            self.counters["retries"] += retries
+            self._win["requests"] += 1
+            if status == "ok":
+                self.counters["ok"] += 1
+                self._win["ok"] += 1
+                self._win_lat.observe(latency_s)
+            elif shed:
+                self.counters["shed"] += 1
+                self._win["shed"] += 1
+            else:
+                self.counters["errors"] += 1
+                self._win["errors"] += 1
+            if version:
+                vs = self._versions.get(version)
+                if vs is None:
+                    vs = self._versions[version] = _VersionStats()
+                if status == "ok":
+                    vs.ok += 1
+                    vs.lat.observe(latency_s)
+                elif not shed:
+                    vs.errors += 1
+        self._emit("fleet_route", protocol=protocol, status=status,
+                   model=model, tenant=tenant, rows=rows,
+                   replica=replica_id, version=version,
+                   retries=retries, latency_ms=latency_s * 1e3)
+
+    def take_window(self) -> Dict[str, Any]:
+        """Counters since the last call plus the CURRENT fleet load —
+        the autoscaler's input. Swapping the window out keeps rates
+        honest without unbounded history."""
+        now = time.monotonic()
+        with self._stats:
+            w = self._win
+            lat = self._win_lat
+            self._win = {"requests": 0, "ok": 0, "shed": 0,
+                         "errors": 0}
+            self._win_lat = LatencyHistogram()
+            t0, self._win_t0 = self._win_t0, now
+        with self._lock:
+            ready = [r for r in self._reps.values()
+                     if r.ready and not r.draining and not r.suspect]
+            queue_rows = sum(r.health.get("queue_rows", 0)
+                             for r in ready)
+            max_batch = max(
+                (m.get("max_batch", 0)
+                 for r in ready
+                 for m in r.health.get("model_health", [])),
+                default=0)
+            total = len(self._reps)
+        return {
+            "requests": w["requests"], "ok": w["ok"],
+            "shed": w["shed"], "errors": w["errors"],
+            "p99_ms": round(lat.percentile(0.99), 3),
+            "queue_rows": queue_rows, "max_batch": max_batch,
+            "ready": len(ready), "replicas": total,
+            "window_s": now - t0,
+        }
+
+    # -- health polling ----------------------------------------------------
+
+    def _poll_once(self, rep: ReplicaState) -> None:
+        try:
+            conn = http.client.HTTPConnection(
+                rep.host, rep.http_port,
+                timeout=max(1.0, self.tier.health_poll_s * 4))
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                payload = json.loads(resp.read())
+                ok = resp.status == 200 and payload.get("ok")
+            finally:
+                conn.close()
+        except (OSError, ValueError):
+            ok, payload = False, None
+        with self._lock:
+            if ok:
+                rep.health = payload
+                rep.fail_polls = 0
+                rep.suspect = False
+                rep.suspect_since = 0.0
+            else:
+                rep.fail_polls += 1
+                if rep.fail_polls >= self.tier.unhealthy_after \
+                        and not rep.suspect:
+                    rep.suspect = True
+                    rep.suspect_since = time.monotonic()
+
+    def _poll_loop(self) -> None:
+        while not self._poll_stop.wait(self.tier.health_poll_s):
+            with self._lock:
+                reps = list(self._reps.values())
+            for rep in reps:
+                self._poll_once(rep)
+
+    # -- own health / status ----------------------------------------------
+
+    def health_snapshot(self) -> Dict[str, Any]:
+        with self._stats:
+            c = dict(self.counters)
+        reps = self.describe_replicas()
+        ready = sum(1 for r in reps
+                    if r["ready"] and not r["draining"]
+                    and not r["suspect"])
+        with self._lock:
+            pin = {"version": self._pin_version,
+                   "fraction": self._pin_fraction} \
+                if self._pin_version else None
+        return {"ok": ready > 0, "tier": "balancer",
+                "ready": ready, "replicas": reps,
+                "requests": c["requests"], "shed": c["shed"],
+                "errors": c["errors"], "retries": c["retries"],
+                "canary": pin,
+                "queue_rows": sum(r["queue_rows"] for r in reps),
+                "resident_bytes": sum(r["resident_bytes"]
+                                      for r in reps)}
+
+    def models_snapshot(self) -> Dict[str, Any]:
+        """``GET /v1/models`` at the balancer: the model table proxied
+        from one ready replica (they all serve the same contract),
+        annotated with the per-version replica split."""
+        with self._lock:
+            cands = [r for r in self._reps.values()
+                     if r.ready and not r.suspect]
+        models: List[Dict[str, Any]] = []
+        for rep in cands:
+            try:
+                conn = http.client.HTTPConnection(
+                    rep.host, rep.http_port, timeout=5.0)
+                try:
+                    conn.request("GET", "/v1/models")
+                    resp = conn.getresponse()
+                    if resp.status == 200:
+                        models = json.loads(resp.read())["models"]
+                        break
+                finally:
+                    conn.close()
+            except (OSError, ValueError):
+                continue          # a dead replica: try the next one
+        versions: Dict[str, int] = {}
+        with self._lock:
+            for r in self._reps.values():
+                versions[r.version] = versions.get(r.version, 0) + 1
+        return {"models": models, "replica_versions": versions}
+
+    # -- listeners ---------------------------------------------------------
+
+    def start(self) -> None:
+        t = self.tier
+        if t.http_port >= 0:
+            self._http_server = _FleetHTTPServer(
+                (t.host, t.http_port), _BalancerHttpHandler, self)
+            self.http_port = self._http_server.server_address[1]
+            th = threading.Thread(
+                target=self._http_server.serve_forever,
+                name="fleet-http", daemon=True)
+            th.start()
+            self._threads.append(th)
+        if t.binary_port >= 0:
+            self._binary_server = _FleetBinaryServer(
+                (t.host, t.binary_port), _BinaryHandler, self)
+            self.binary_port = self._binary_server.server_address[1]
+            th = threading.Thread(
+                target=self._binary_server.serve_forever,
+                name="fleet-binary", daemon=True)
+            th.start()
+            self._threads.append(th)
+        poller = threading.Thread(target=self._poll_loop,
+                                  name="fleet-health", daemon=True)
+        poller.start()
+        self._threads.append(poller)
+
+    def close(self) -> Dict[str, Any]:
+        self._closing = True
+        self._poll_stop.set()
+        for srv in (self._http_server, self._binary_server):
+            if srv is not None:
+                srv.shutdown()
+                srv.server_close()
+        for th in self._threads:
+            th.join(timeout=30)
+        with self._lock:
+            reps = list(self._reps.values())
+            self._reps = {}
+        for rep in reps:
+            rep.close_pool()
+        with self._stats:
+            return dict(self.counters)
+
+
+# -- balancer HTTP protocol ------------------------------------------------
+#
+# Reuses the fleet front end's JSON plumbing (_send_json, keep-alive,
+# no access log); only the introspection payloads differ — requests go
+# through FleetBalancer.handle, which shares FleetServer.handle's
+# contract, so the POST body/reply grammar is identical on purpose.
+
+
+class _BalancerHttpHandler(_HttpHandler):
+
+    def do_GET(self):
+        bal = self.server.fleet
+        if self.path == "/healthz":
+            self._send_json(200, bal.health_snapshot())
+        elif self.path == "/v1/models":
+            self._send_json(200, bal.models_snapshot())
+        else:
+            self._send_json(404, {"error": "not_found",
+                                  "message": "unknown path %r"
+                                  % self.path})
+
+    def do_POST(self):
+        bal = self.server.fleet
+        if self.path != "/v1/predict":
+            self._send_json(404, {"error": "not_found",
+                                  "message": "POST /v1/predict"})
+            return
+        t0 = time.monotonic()
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            model = str(req.get("model", ""))
+            tenant = str(req.get("tenant", ""))
+            timeout_ms = req.get("timeout_ms")
+            rows = req["rows"]
+        except (ValueError, KeyError, TypeError) as e:
+            bal._record("http", "bad_request", "", "", 0, "", "", 0,
+                        t0)
+            self._send_json(400, {"error": "bad_request",
+                                  "message": "body must be JSON with "
+                                  "'rows': %s" % e})
+            return
+        status, result, extra = bal.handle(
+            model, tenant, rows, protocol="http",
+            timeout_ms=timeout_ms)
+        code = HTTP_STATUS[status]
+        if status == "ok":
+            flat = np.asarray(result)
+            self._send_json(code, {
+                "model": model,
+                "rows": int(flat.shape[0]),
+                "result": flat.reshape(flat.shape[0], -1).tolist()})
+            return
+        headers = {}
+        if status in ("busy", "over_quota"):
+            headers["Retry-After"] = "%d" % max(
+                1, int(extra.get("retry_after_s", 1) + 0.999))
+        self._send_json(code, dict(
+            {"error": status, "message": result}, **extra),
+            headers=headers)

@@ -66,6 +66,15 @@ HOT_PATH_ROOTS = {
         "DynamicBatcher._collect_loop",
         "DynamicBatcher._dispatch_loop",
     ),
+    # the fleet balancer's per-request path (doc/serving.md
+    # "Horizontal fleet"): every fleet request funnels through
+    # handle -> _route -> _forward, so a host sync added there taxes
+    # the whole fleet's latency, not one engine's
+    "cxxnet_tpu/fleet/balancer.py": (
+        "FleetBalancer.handle",
+        "FleetBalancer._route",
+        "FleetBalancer._forward",
+    ),
 }
 
 # -- CXL004: telemetry schema ---------------------------------------------

@@ -324,6 +324,11 @@ class LearnTask:
                 # deployment config's train blocks may point at paths
                 # the serving host does not mount)
                 return self._task_serve_fleet(cfg)
+            if self.task == "fleet":
+                # the horizontal tier: balancer + autoscaler + canary
+                # over N replica processes, each a task=serve_fleet
+                # child spawned from this same config file
+                return self._task_fleet(cfg, argv[0], argv[1:])
             if self.task == "export":
                 # sealing a snapshot into a bundle needs no data
                 # either — only the net config and the serve contract
@@ -863,6 +868,62 @@ class LearnTask:
         if mon.enabled:
             mon.emit("task_end", task="serve_fleet",
                      requests=c["requests"], swaps=summary["swaps"])
+        return 0
+
+    def _task_fleet(self, cfg, conf_path: str,
+                    cli_overrides: List[str]) -> int:
+        """Horizontal fleet (doc/serving.md "Horizontal fleet"): a
+        front-of-fleet balancer + autoscale controller (+ optional
+        canary rollout) over N shared-nothing ``serve_fleet`` replica
+        processes spawned from this same config file. Runs for
+        ``fleet_duration_s`` seconds (0 = until SIGTERM/SIGINT), then
+        drains every replica cleanly — scale-in order on every exit
+        path: deroute, wait in-flight, SIGTERM."""
+        assert world_size() == 1, "task=fleet must run single-process"
+        from .fleet import FleetController
+        mon = self._mon
+        if mon.enabled:
+            mon.emit("run_start",
+                     **run_metadata("fleet", self._cfg_stream))
+        controller = FleetController(cfg, conf_path, monitor=mon,
+                                     extra_overrides=cli_overrides)
+        handlers = []
+        summary = {}
+        try:
+            controller.start()
+            bal = controller.balancer
+            mon.line("fleet: balancer http=%s binary=%s, %d replicas "
+                     "serving %s%s"
+                     % (bal.http_port, bal.binary_port,
+                        controller.ready_count(),
+                        controller.current_version(),
+                        ", canary %s armed"
+                        % controller.canary.canary_version
+                        if controller.canary else ""))
+            handlers = self._install_preempt_handlers()
+            dur = controller.tier.duration_s
+            deadline = time.monotonic() + dur if dur > 0 else None
+            while self._preempt_signum is None:
+                if deadline is not None \
+                        and time.monotonic() >= deadline:
+                    break
+                time.sleep(0.05)
+        finally:
+            # a failure between start and the wait loop must still
+            # stop the scale thread, drain replicas, and close the
+            # listeners (close is idempotent per component)
+            summary = controller.close()
+            self._restore_handlers(handlers)
+        mon.line("fleet: %d requests (%d ok / %d shed / %d error, "
+                 "%d retries recovered)%s"
+                 % (summary.get("requests", 0), summary.get("ok", 0),
+                    summary.get("shed", 0), summary.get("errors", 0),
+                    summary.get("retries", 0),
+                    ", canary %s" % summary["canary"]
+                    if "canary" in summary else ""))
+        if mon.enabled:
+            mon.emit("task_end", task="fleet",
+                     requests=summary.get("requests", 0))
         return 0
 
     def _task_export(self, cfg) -> int:

@@ -72,6 +72,18 @@ REQUIRED: Dict[str, tuple] = {
     "tenant_shed": ("tenant", "model", "rows", "rate", "burst"),
     "hot_swap": ("model", "old_counter", "new_counter", "path",
                  "warmup_programs", "old_requests", "wall_ms"),
+    # horizontal fleet (doc/serving.md "Horizontal fleet"): the
+    # balancer's per-request routing outcome (which replica answered,
+    # how many transparent retries a replica loss cost), the
+    # controller's scale / replica-lifecycle actions, and the canary
+    # rollout decision trail (start / promote / rollback — the
+    # promote/rollback record doubles as the schema-validated decision
+    # record written to canary_out)
+    "fleet_route": ("protocol", "status", "model", "tenant", "rows",
+                    "replica", "version", "retries", "latency_ms"),
+    "fleet_scale": ("action", "replicas", "ready", "reason"),
+    "canary": ("phase", "baseline_version", "canary_version",
+               "fraction", "reason"),
     # crash-safe checkpointing (doc/checkpointing.md): per-snapshot
     # commit accounting (phase split shows the training thread paid
     # only gather_ms when async), retention GC, the validated-resume

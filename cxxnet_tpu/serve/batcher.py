@@ -349,6 +349,22 @@ class DynamicBatcher:
             pad_fraction=(bucket - rows) / float(bucket),
             queue_ms=(t_form - oldest) * 1e3, device_ms=device_ms)
 
+    # -- load introspection ----------------------------------------------
+
+    def queue_rows(self) -> int:
+        """Rows currently waiting in the coalescing queue — the load
+        signal the fleet tier's ``/healthz`` exports for balancer
+        routing and autoscale decisions (doc/serving.md "Horizontal
+        fleet")."""
+        with self._lock:
+            return self._pending_rows
+
+    def latency_percentile(self, q: float) -> float:
+        """Request-latency percentile (ms) over the batcher's lifetime
+        histogram — the ``p99_ms`` health signal."""
+        with self._stats:
+            return self._lat.percentile(q)
+
     # -- shutdown --------------------------------------------------------
 
     def close(self, drain: bool = True,

@@ -36,6 +36,7 @@ sheds additionally emit ``tenant_shed``; swaps emit ``hot_swap``.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import socketserver
 import struct
@@ -207,6 +208,10 @@ class FleetConfig:
       watchers).
     - ``serve_fleet_duration_s`` — CLI run time (0 = until
       SIGTERM/SIGINT).
+    - ``serve_port_file`` — when set, ``start()`` writes a small JSON
+      file (pid + resolved listen ports) there atomically; how a
+      parent fleet controller learns the ephemeral ports of a replica
+      it spawned (doc/serving.md "Horizontal fleet").
     """
 
     def __init__(self, cfg: Sequence):
@@ -217,6 +222,7 @@ class FleetConfig:
         self.swap_poll_s = 2.0
         self.duration_s = 0.0
         self.mem_budget_mb = 0.0
+        self.port_file = ""
         model_dir, model_in = "./models", ""
         for name, val in cfg:
             if name == "serve_models":
@@ -233,6 +239,8 @@ class FleetConfig:
                 self.duration_s = float(val)
             if name == "serve_device_mem_budget":
                 self.mem_budget_mb = float(val)
+            if name == "serve_port_file":
+                self.port_file = val
             if name == "model_dir":
                 model_dir = val
             if name == "model_in":
@@ -445,8 +453,26 @@ class FleetServer:
                    model=model, tenant=tenant, rows=rows,
                    latency_ms=(time.monotonic() - t0) * 1e3)
 
+    # runtime-fingerprint hashes are constant per (process, mesh
+    # shape): memoize so the introspection endpoints operators poll
+    # don't re-walk jax.devices() per model per request
+    _fp_sha_cache: Dict[tuple, str] = {}
+
+    @classmethod
+    def _fingerprint_sha(cls, mesh) -> str:
+        from ..artifact.bundle import (fingerprint_sha,
+                                       runtime_fingerprint)
+        key = tuple(sorted(dict(mesh.shape).items())) \
+            if mesh is not None else ()
+        sha = cls._fp_sha_cache.get(key)
+        if sha is None:
+            sha = fingerprint_sha(runtime_fingerprint(mesh))
+            cls._fp_sha_cache[key] = sha
+        return sha
+
     def describe(self) -> List[Dict[str, Any]]:
         """Model table with the client-facing dispatch contract."""
+        from ..artifact.bundle import is_bundle
         out = []
         for e in (self.router.resolve(m) for m in self.router.ids()):
             inst = e.session.engine._inst_shape()
@@ -460,8 +486,61 @@ class FleetServer:
                 # per-model device-memory accounting (doc/serving.md
                 # "Device memory accounting")
                 "device_mem_bytes": e.resident_bytes,
+                # version identity (doc/serving.md "Horizontal
+                # fleet"): which bundle/snapshot counter this engine
+                # was booted from, whether the source was a sealed
+                # bundle, and the runtime-fingerprint hash its
+                # executables are valid against — what the canary
+                # comparator and operators key per-version telemetry
+                # on
+                "bundle": bool(is_bundle(e.path)),
+                "fingerprint_sha256": self._fingerprint_sha(
+                    e.session.engine.trainer.mesh),
             })
         return out
+
+    def health_snapshot(self) -> Dict[str, Any]:
+        """Load-aware health for ``GET /healthz`` — the signals the
+        fleet balancer routes on and the autoscaler differentiates
+        between polls (doc/serving.md "Horizontal fleet"): cumulative
+        request/shed/error counters, current queued rows, lifetime
+        p99, resident device bytes, and per-model version identity +
+        compile accounting."""
+        with self._stats:
+            c = dict(self.counters)
+        shed = c.get("busy", 0) + c.get("over_quota", 0)
+        models = []
+        queue_rows = 0
+        p99 = 0.0
+        for e in (self.router.resolve(m) for m in self.router.ids()):
+            batcher = e.session.batcher
+            # read each signal ONCE so the per-model rows always sum/
+            # max to the aggregates (and each poll takes the batcher
+            # locks once per model, not twice)
+            m_queue = batcher.queue_rows()
+            m_p99 = batcher.latency_percentile(0.99)
+            queue_rows += m_queue
+            p99 = max(p99, m_p99)
+            snap = e.session.engine.counters_snapshot()
+            models.append({
+                "model": e.model_id, "counter": e.counter,
+                "generation": e.generation,
+                "max_batch": e.session.engine.max_batch,
+                "queue_rows": m_queue,
+                "p99_ms": round(m_p99, 3),
+                "compile_events": snap["compile_events"],
+                "aot_hits": snap["aot_hits"],
+            })
+        return {
+            "ok": True, "pid": os.getpid(),
+            "models": self.router.ids(),
+            "requests": c["requests"], "shed": shed,
+            "errors": c.get("error", 0) + c.get("closed", 0),
+            "queue_rows": queue_rows,
+            "p99_ms": round(p99, 3),
+            "resident_bytes": self.router.resident_bytes_total(),
+            "model_health": models,
+        }
 
     # -- listeners --------------------------------------------------------
 
@@ -488,6 +567,23 @@ class FleetServer:
             self._threads.append(t)
         for w in self._watchers:
             w.start()
+        if c.port_file:
+            self._write_port_file(c.port_file)
+
+    def _write_port_file(self, path: str) -> None:
+        """Atomically publish the resolved listen ports (tmp +
+        rename): a fleet controller polling for this file must never
+        read a torn write."""
+        payload = json.dumps({"pid": os.getpid(),
+                              "http_port": self.http_port,
+                              "binary_port": self.binary_port})
+        d = os.path.dirname(os.path.abspath(path))
+        if d and not os.path.isdir(d):
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, path)
 
     def close(self, drain: bool = True) -> Dict[str, Any]:
         """Stop watchers, stop intake (listeners), drain every
@@ -545,8 +641,7 @@ class _HttpHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         fleet = self.server.fleet
         if self.path == "/healthz":
-            self._send_json(200, {"ok": True,
-                                  "models": fleet.router.ids()})
+            self._send_json(200, fleet.health_snapshot())
         elif self.path == "/v1/models":
             self._send_json(200, {"models": fleet.describe()})
         else:

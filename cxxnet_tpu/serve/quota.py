@@ -72,8 +72,16 @@ class TokenBucket:
         self._lock = threading.Lock()
 
     def _refill(self, now: float) -> None:
+        # clamp against a backwards clock step: time.monotonic() is
+        # contractually monotonic, but a mocked/virtualized clock (or a
+        # future caller passing wall time) must never MINT tokens from
+        # a negative elapsed interval, and must not drag _t backwards
+        # (which would double-mint when the clock recovers)
+        elapsed = now - self._t
+        if elapsed <= 0:
+            return
         self._tokens = min(self.burst,
-                           self._tokens + (now - self._t) * self.rate)
+                           self._tokens + elapsed * self.rate)
         self._t = now
 
     def try_take(self, n: float) -> Tuple[bool, float]:
@@ -128,7 +136,14 @@ class QuotaManager:
         self._explicit: Dict[str, Optional[Tuple[float, float]]] = {}
         self._default: Optional[Tuple[float, float]] = None
         for name, val in cfg:
+            # a blank value UNSETS the policy: the fleet tier moves
+            # quota enforcement to the balancer and spawns replicas
+            # with serve_quota= / serve_quota_default= overrides so a
+            # conf-file policy is not double-enforced per replica
             if name == "serve_quota":
+                if not val.strip():
+                    self._explicit = {}
+                    continue
                 for entry in val.split(","):
                     entry = entry.strip()
                     if not entry:
@@ -140,7 +155,8 @@ class QuotaManager:
                             "tenant:rate[:burst]" % entry)
                     self._explicit[tenant] = _parse_bucket_spec(spec)
             if name == "serve_quota_default":
-                self._default = _parse_bucket_spec(val)
+                self._default = _parse_bucket_spec(val) \
+                    if val.strip() else None
         self._buckets: Dict[str, TokenBucket] = {}
         self._lock = threading.Lock()
         self.counters: Dict[str, int] = {"admitted": 0, "shed": 0}

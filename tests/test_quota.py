@@ -1,0 +1,142 @@
+"""Token-bucket quota edge cases (serve/quota.py): burst refill after
+long idle, zero-rate tenants, concurrent acquire under contention, and
+clock-monotonicity — a backwards clock step must not mint tokens (nor
+double-mint when the clock recovers)."""
+
+import threading
+import time
+
+import pytest
+
+from cxxnet_tpu.serve import QuotaManager, TenantQuotaError, TokenBucket
+
+
+class _FakeClock:
+    """Deterministic stand-in for time.monotonic, steppable both ways
+    (the monotonic contract is exactly what the bucket must DEFEND
+    against being violated by a mocked/virtualized source)."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    c = _FakeClock()
+    monkeypatch.setattr("cxxnet_tpu.serve.quota.time.monotonic", c)
+    return c
+
+
+def test_burst_refill_after_long_idle_caps_at_burst(clock):
+    """An idle tenant earns back at most one burst, not rate x idle
+    seconds — a tenant silent for an hour must not get a 36000-row
+    hammer at rate 10."""
+    b = TokenBucket(rate=10.0, burst=20.0)
+    ok, _ = b.try_take(20)
+    assert ok
+    ok, _ = b.try_take(1)
+    assert not ok                       # drained
+    clock.t += 3600.0                   # one idle hour
+    assert b.available() == pytest.approx(20.0)   # burst, not 36000
+    ok, _ = b.try_take(20)
+    assert ok
+    ok, _ = b.try_take(1)
+    assert not ok                       # and only one burst
+
+
+def test_partial_refill_is_rate_proportional(clock):
+    b = TokenBucket(rate=10.0, burst=20.0)
+    b.try_take(20)
+    clock.t += 0.5                      # 5 tokens earned
+    ok, _ = b.try_take(5)
+    assert ok
+    ok, retry = b.try_take(5)
+    assert not ok and retry == pytest.approx(0.5)
+
+
+def test_backwards_clock_step_mints_nothing(clock):
+    """A backwards step must not mint tokens, and must not drag the
+    refill anchor backwards (which would double-mint once the clock
+    recovers to where it was)."""
+    b = TokenBucket(rate=100.0, burst=10.0)
+    b.try_take(10)                      # drained at t=1000
+    clock.t -= 50.0                     # clock jumps back
+    assert b.available() == 0.0         # nothing minted
+    ok, _ = b.try_take(1)
+    assert not ok
+    clock.t += 50.0                     # clock recovers to t=1000
+    # no double-mint: zero net time has passed since the drain
+    assert b.available() == 0.0
+    clock.t += 0.05                     # 5 real tokens
+    assert b.available() == pytest.approx(5.0)
+
+
+def test_zero_rate_tenant_is_exempt_and_gets_no_bucket():
+    q = QuotaManager([("serve_quota", "vip:0"),
+                      ("serve_quota_default", "0")])
+    for _ in range(100):
+        q.admit("vip", 10 ** 6)         # explicit rate 0: unlimited
+        q.admit("anyone", 10 ** 6)      # default rate 0: unlimited
+    assert q.snapshot()["shed"] == 0
+    # no buckets were materialized for exempt tenants
+    assert q._buckets == {}
+
+
+def test_blank_quota_value_unsets_policy():
+    """The fleet controller strips quotas from replica configs by
+    appending blank overrides — a blank value must UNSET, not crash
+    on float('')."""
+    q = QuotaManager([("serve_quota", "free:1:1"),
+                      ("serve_quota_default", "1:1"),
+                      ("serve_quota", ""),
+                      ("serve_quota_default", " ")])
+    for _ in range(10):
+        q.admit("free", 100)
+        q.admit("anyone", 100)
+    assert q.snapshot()["shed"] == 0
+
+
+def test_concurrent_acquire_never_overspends():
+    """N threads hammering one tenant's bucket: the total admitted
+    rows can never exceed burst + rate x elapsed (with a generous
+    margin for the final in-flight refill) — the lost-update race
+    would admit far more."""
+    q = QuotaManager([("serve_quota", "t:1000:50")])
+    admitted = [0] * 8
+    t0 = time.monotonic()
+
+    def worker(i):
+        for _ in range(400):
+            try:
+                q.admit("t", 1)
+                admitted[i] += 1
+            except TenantQuotaError:
+                pass
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    total = sum(admitted)
+    assert total >= 50                  # at least the burst went through
+    assert total <= 50 + 1000 * elapsed + 8   # no over-mint under contention
+    snap = q.snapshot()
+    assert snap["admitted"] == total
+    assert snap["shed"] == 8 * 400 - total
+
+
+def test_oversized_request_sheds_deterministically():
+    """A request larger than burst can NEVER be admitted — it must
+    shed with a finite retry_after capped at one full-burst wait, not
+    queue forever chasing tokens that cannot accumulate."""
+    q = QuotaManager([("serve_quota", "t:10:4")])
+    for _ in range(3):
+        with pytest.raises(TenantQuotaError) as ei:
+            q.admit("t", 100)
+        assert ei.value.retry_after_s <= 4 / 10 + 1e-6

@@ -394,6 +394,371 @@ def run_multi_tenant(args, monitor, sink):
     return rec, slo_ok, zero_recompiles
 
 
+# -- multi-replica fleet scenario (--replicas) ----------------------------
+
+
+def _get_json(port, path):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _seal_bench_bundle(cfg, snapshot, monitor):
+    """Seal the bench model into a bundle so every replica boots with
+    zero-compile cold start — the mechanism that makes scale-out
+    cheap (doc/artifacts.md), exercised instead of assumed."""
+    from cxxnet_tpu.artifact.bundle import (default_bundle_path,
+                                            export_bundle)
+    from cxxnet_tpu.serve import ServeConfig, build_engine
+    sc = ServeConfig(cfg)
+    engine = build_engine(cfg, snapshot, buckets=sc.buckets,
+                          max_batch=sc.max_batch, node=sc.node,
+                          monitor=monitor)
+    engine.warmup(warm_run=False)
+    out = default_bundle_path(snapshot)
+    export_bundle(engine, out, node=sc.node, monitor=monitor)
+    return out
+
+
+def _drive_fleet(ctl, pool, clients, requests, request_rows,
+                 mid_traffic=None):
+    """Closed-loop binary clients against the balancer; returns
+    per-outcome counts. ``mid_traffic`` (optional callable) runs on
+    the driver thread once traffic is established — the kill
+    injector. Sheds (busy/over_quota) are back-off signals, not
+    failures; anything else non-ok is a failed request."""
+    import threading
+
+    from cxxnet_tpu.serve import BinaryClient
+
+    counts = {"ok": 0, "shed": 0, "failed": []}
+    lock = threading.Lock()
+
+    def client(ci):
+        bc = BinaryClient("127.0.0.1", ctl.balancer.binary_port,
+                          timeout=120)
+        try:
+            for r in range(requests):
+                start = (ci * requests + r) * request_rows % 256
+                rows = np.take(pool,
+                               range(start, start + request_rows),
+                               axis=0, mode="wrap")
+                try:
+                    status, _ = bc.predict(rows)
+                except Exception as e:
+                    with lock:
+                        counts["failed"].append(repr(e))
+                    break
+                with lock:
+                    if status == "ok":
+                        counts["ok"] += 1
+                    elif status in ("busy", "over_quota"):
+                        counts["shed"] += 1
+                    else:
+                        counts["failed"].append(status)
+        finally:
+            bc.close()
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    if mid_traffic is not None:
+        mid_traffic()
+    for t in threads:
+        t.join()
+    counts["wall_s"] = time.time() - t0
+    return counts
+
+
+def _fleet_point_stats(sink, counts, request_rows):
+    """One sweep-point row read back from the fleet_route records."""
+    lat = sorted(r["latency_ms"] for r in sink.records
+                 if r["event"] == "fleet_route"
+                 and r["status"] == "ok")
+
+    def pct(q):
+        return round(lat[min(len(lat) - 1, int(q * len(lat)))], 3) \
+            if lat else 0.0
+
+    retries = sum(r["retries"] for r in sink.records
+                  if r["event"] == "fleet_route")
+    return {
+        "requests_ok": counts["ok"], "requests_shed": counts["shed"],
+        "requests_failed": len(counts["failed"]),
+        "rows_per_sec": round(
+            counts["ok"] * request_rows / counts["wall_s"], 2)
+        if counts["wall_s"] > 0 else 0.0,
+        "latency_p50_ms": pct(0.50), "latency_p99_ms": pct(0.99),
+        "retries_recovered": retries,
+        "wall_s": round(counts["wall_s"], 2),
+    }
+
+
+def _fleet_compile_events(ctl):
+    """Post-warmup compile events summed over every live replica's
+    /healthz — the fleet-wide zero-recompile gate."""
+    total = 0
+    for rep in ctl.manager.replicas():
+        if not rep.alive():
+            continue
+        try:
+            h = _get_json(rep.http_port, "/healthz")
+        except (OSError, ValueError):
+            continue   # died/retired between listing and probing
+        total += sum(m["compile_events"]
+                     for m in h.get("model_health", []))
+    return total
+
+
+def run_multi_replica(args, monitor, sink):
+    """``--replicas N1,N2,...``: rows/s + p99 at each fleet size,
+    then (at the largest size) the kill-a-replica scenario — SIGKILL
+    one replica process mid-traffic, assert ZERO failed requests —
+    and, with ``--autoscale-soak S``, an elasticity soak: drive load
+    until the controller scales out, go idle until it drains back,
+    zero dropped requests throughout."""
+    import os
+    import signal
+    import tempfile
+
+    from cxxnet_tpu.fleet import FleetController
+    from cxxnet_tpu.monitor.schema import validate_records
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.parallel import make_mesh
+    from cxxnet_tpu.utils.config import parse_config, parse_config_file
+
+    rng = np.random.RandomState(0)
+    sizes = [int(t) for t in args.replicas.split(",") if t]
+    record = {"name": "serve_bench", "mode": "multi_replica",
+              "t": time.time(),
+              "requests_per_client": args.requests,
+              "request_rows": args.request_rows,
+              "buckets": args.buckets,
+              "max_delay_ms": args.max_delay_ms,
+              "dtype": args.serve_dtype or "float32",
+              "slo_p99_ms": args.slo_p99_ms}
+    failures, recompiles = 0, 0
+    # the CLI serve knobs must reach the REPLICA processes (which read
+    # conf_path + these overrides), or the record would label a sweep
+    # that never ran with them
+    serve_overrides = [
+        "serve_buckets=%s" % args.buckets,
+        "serve_max_delay_ms=%g" % args.max_delay_ms,
+        "serve_queue_rows=%d" % (args.queue_rows or 4096),
+    ]
+    if args.serve_dtype:
+        serve_overrides.append("serve_dtype=%s" % args.serve_dtype)
+    with tempfile.TemporaryDirectory() as td:
+        if args.conf:
+            assert args.model_in, "--conf needs --model-in"
+            conf_path = args.conf
+            cfg = parse_config_file(args.conf) + [
+                (p.split("=", 1)[0], p.split("=", 1)[1])
+                for p in serve_overrides]
+            source = args.artifact or args.model_in
+        else:
+            conf_text = SYNTH_CONF + (
+                "\nserve_buckets = %s\nserve_max_delay_ms = %g\n"
+                "serve_queue_rows = %d\n"
+                % (args.buckets, args.max_delay_ms,
+                   args.queue_rows or 4096))
+            if args.serve_dtype:
+                conf_text += "serve_dtype = %s\n" % args.serve_dtype
+            conf_path = os.path.join(td, "bench.conf")
+            with open(conf_path, "w") as f:
+                f.write(conf_text)
+            cfg = parse_config(conf_text)
+            trainer = NetTrainer(cfg, mesh=make_mesh(1, 1))
+            trainer.init_model()
+            snap = os.path.join(td, "0001.model.npz")
+            trainer.save_model(snap)
+            # replicas boot from the sealed bundle: zero-compile cold
+            # start is the whole reason scale-out is cheap
+            source = args.artifact or _seal_bench_bundle(cfg, snap,
+                                                         monitor)
+        record["model"] = os.path.basename(source)
+        pool = None
+        tier_base = [
+            ("model_in", source),
+            ("fleet_http_port", "0"), ("fleet_binary_port", "0"),
+            ("fleet_health_poll_s", "0.2"),
+            ("fleet_dir", os.path.join(td, "run")),
+        ]
+
+        def boot(n, extra=()):
+            ctl = FleetController(
+                cfg + tier_base + [("fleet_replicas", str(n)),
+                                   ("fleet_min_replicas", str(n))]
+                + list(extra),
+                conf_path=conf_path, monitor=monitor,
+                extra_overrides=serve_overrides)
+            ctl.start()
+            return ctl
+
+        sweep = []
+        for n in sizes:
+            sink.clear()
+            t0 = time.time()
+            ctl = boot(n)
+            boot_s = time.time() - t0
+            if pool is None:
+                inst = tuple(_get_json(
+                    ctl.manager.replicas()[0].http_port,
+                    "/v1/models")["models"][0]["instance_shape"])
+                pool = rng.uniform(0, 1, size=(256,) + inst) \
+                    .astype(np.float32)
+            counts = _drive_fleet(ctl, pool, clients=4 * n,
+                                  requests=args.requests,
+                                  request_rows=args.request_rows)
+            recompiles += _fleet_compile_events(ctl)
+            ctl.close()
+            errs = validate_records(sink.records)
+            assert not errs, "schema-invalid fleet telemetry: %s" \
+                % errs[:5]
+            pt = dict(_fleet_point_stats(sink, counts,
+                                         args.request_rows),
+                      replicas=n, clients=4 * n,
+                      boot_s=round(boot_s, 2))
+            failures += pt["requests_failed"]
+            sweep.append(pt)
+            print("# replicas=%d: %.1f rows/s, p50 %.2f ms, p99 "
+                  "%.2f ms, %d ok / %d failed"
+                  % (n, pt["rows_per_sec"], pt["latency_p50_ms"],
+                     pt["latency_p99_ms"], pt["requests_ok"],
+                     pt["requests_failed"]), file=sys.stderr)
+        record["sweep"] = sweep
+
+        # -- kill-a-replica mid-traffic (at the largest fleet) -------
+        sink.clear()
+        n = max(sizes)
+        ctl = boot(n, extra=[("fleet_scale_interval_s", "0.2")])
+
+        def killer():
+            time.sleep(0.3)           # let traffic establish
+            victim = ctl.manager.replicas()[0]
+            os.kill(victim.pid, signal.SIGKILL)
+            print("# killed replica %s (pid %d) mid-traffic"
+                  % (victim.replica_id, victim.pid), file=sys.stderr)
+
+        counts = _drive_fleet(ctl, pool, clients=4 * n,
+                              requests=args.requests,
+                              request_rows=args.request_rows,
+                              mid_traffic=killer)
+        healed = sum(1 for r in ctl.manager.replicas()
+                     if r.alive()) >= n
+        recompiles += _fleet_compile_events(ctl)
+        ctl.close()
+        kill_pt = _fleet_point_stats(sink, counts, args.request_rows)
+        kill_pt.update({
+            "replicas": n, "replica_killed": True,
+            "self_healed": healed,
+            "replica_lost_events": sum(
+                1 for r in sink.records
+                if r["event"] == "fleet_scale"
+                and r["action"] == "replica_lost"),
+        })
+        failures += kill_pt["requests_failed"]
+        record["kill_replica"] = kill_pt
+        print("# kill-a-replica: %d ok / %d failed, %d retries "
+              "recovered, self_healed=%s"
+              % (kill_pt["requests_ok"], kill_pt["requests_failed"],
+                 kill_pt["retries_recovered"], healed),
+              file=sys.stderr)
+
+        # -- autoscale soak ------------------------------------------
+        if args.autoscale_soak > 0:
+            sink.clear()
+            ctl = boot(1, extra=[
+                ("fleet_min_replicas", "1"),
+                ("fleet_max_replicas", str(max(2, max(sizes)))),
+                ("fleet_scale_interval_s", "0.3"),
+                ("fleet_scale_up_after_s", "0.6"),
+                ("fleet_scale_down_after_s", "1.5"),
+            ])
+            import threading
+            stop = threading.Event()
+            soak = {"ok": 0, "shed": 0, "failed": []}
+            lock = threading.Lock()
+
+            def hammer(ci):
+                from cxxnet_tpu.serve import BinaryClient
+                bc = BinaryClient("127.0.0.1",
+                                  ctl.balancer.binary_port,
+                                  timeout=120)
+                try:
+                    while not stop.is_set():
+                        rows = pool[(ci * 7) % 128:
+                                    (ci * 7) % 128 + 8]
+                        try:
+                            status, _ = bc.predict(rows)
+                        except Exception as e:
+                            with lock:
+                                soak["failed"].append(repr(e))
+                            return
+                        with lock:
+                            if status == "ok":
+                                soak["ok"] += 1
+                            elif status in ("busy", "over_quota"):
+                                soak["shed"] += 1
+                            else:
+                                soak["failed"].append(status)
+                finally:
+                    bc.close()
+
+            threads = [threading.Thread(target=hammer, args=(i,))
+                       for i in range(16)]
+            for t in threads:
+                t.start()
+            deadline = time.time() + args.autoscale_soak
+
+            def saw(action):
+                return any(r["event"] == "fleet_scale"
+                           and r["action"] == action
+                           for r in sink.records)
+
+            while time.time() < deadline and not saw("scale_out"):
+                time.sleep(0.2)
+            scaled_out = saw("scale_out")
+            stop.set()
+            for t in threads:
+                t.join()
+            deadline = time.time() + args.autoscale_soak
+            while time.time() < deadline and not saw("scale_in"):
+                time.sleep(0.2)
+            recompiles += _fleet_compile_events(ctl)
+            ctl.close()
+            record["autoscale"] = {
+                "scaled_out": scaled_out, "scaled_in": saw("scale_in"),
+                "requests_ok": soak["ok"],
+                "requests_shed": soak["shed"],
+                "requests_failed": len(soak["failed"]),
+                "max_ready_seen": max(
+                    (r["ready"] for r in sink.records
+                     if r["event"] == "fleet_scale"), default=1),
+            }
+            failures += len(soak["failed"])
+            if not (scaled_out and record["autoscale"]["scaled_in"]):
+                failures += 1          # the soak's own assertion
+            print("# autoscale soak: out=%s in=%s, %d ok / %d shed "
+                  "/ %d failed"
+                  % (scaled_out, record["autoscale"]["scaled_in"],
+                     soak["ok"], soak["shed"],
+                     len(soak["failed"])), file=sys.stderr)
+    slo_ok = all(p["latency_p99_ms"] <= args.slo_p99_ms
+                 for p in sweep) if args.slo_p99_ms else True
+    record["slo_ok"] = slo_ok
+    record["zero_recompiles"] = recompiles == 0
+    record["zero_failed_requests"] = failures == 0
+    return record, failures == 0 and slo_ok, recompiles == 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", default="1,2,4,8",
@@ -421,6 +786,20 @@ def main(argv=None) -> int:
                     help="multi-tenant scenario: comma list of "
                          "name:clients[:rate[:burst]] (rate in "
                          "rows/s; 0 = unlimited)")
+    ap.add_argument("--replicas", default="",
+                    help="multi-replica fleet scenario: comma list "
+                         "of replica-process counts (e.g. 1,2,4); "
+                         "each point boots a fleet of N task="
+                         "serve_fleet processes from a sealed bundle "
+                         "behind the balancer, plus a kill-a-replica-"
+                         "mid-traffic assertion (zero failed "
+                         "requests) at the largest N")
+    ap.add_argument("--autoscale-soak", type=float, default=0.0,
+                    help="with --replicas: also run an autoscale "
+                         "soak capped at this many seconds per "
+                         "phase — load until the controller scales "
+                         "out, idle until it drains back in, zero "
+                         "dropped requests throughout")
     ap.add_argument("--slo-p99-ms", type=float, default=0.0,
                     help="per-tenant ok-request p99 SLO; breach "
                          "exits 3 (0 = no assertion)")
@@ -460,11 +839,31 @@ def main(argv=None) -> int:
         ap.error("--artifact drives the closed-loop sweep; drop "
                  "--tenants (fleet configs name bundles in "
                  "serve_models instead)")
+    if args.replicas and args.tenants:
+        ap.error("--replicas and --tenants are separate scenarios; "
+                 "run them as two invocations")
+    if args.autoscale_soak and not args.replicas:
+        ap.error("--autoscale-soak needs --replicas")
 
     from cxxnet_tpu.monitor import MemorySink, Monitor
     import jax
     sink = MemorySink()
     monitor = Monitor(sink)
+    if args.replicas:
+        rec, clean, zero_recompiles = run_multi_replica(
+            args, monitor, sink)
+        rec["platform"] = jax.default_backend()
+        out = json.dumps(rec, sort_keys=True)
+        print(out)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(out + "\n")
+        # exit-code convention (bench.py): 1 = the capture itself is
+        # bad (post-warmup recompiles), 2 = argparse usage, 3 = the
+        # fleet dropped requests / breached its SLO / failed the soak
+        if not zero_recompiles:
+            return 1
+        return 0 if clean else 3
     if args.tenants:
         rec, slo_ok, zero_recompiles = run_multi_tenant(
             args, monitor, sink)
