@@ -22,15 +22,16 @@ service:
   schema-validated decision record.
 """
 
-from .balancer import (FleetBalancer, ReplicaState,
-                       ReplicaUnreachable)
+from .balancer import (FleetBalancer, ReplicaChannel, ReplicaState,
+                       ReplicaUnreachable, ReplicaV1Only)
 from .canary import CanaryRollout, canary_decision
 from .config import FleetTierConfig, models_spec, version_of
 from .controller import FleetController, classify_load
 from .replica import ReplicaManager, ReplicaProcess, SpawnError
 
 __all__ = [
-    "FleetBalancer", "ReplicaState", "ReplicaUnreachable",
+    "FleetBalancer", "ReplicaChannel", "ReplicaState",
+    "ReplicaUnreachable", "ReplicaV1Only",
     "CanaryRollout", "canary_decision", "FleetTierConfig",
     "models_spec", "version_of", "FleetController", "classify_load",
     "ReplicaManager", "ReplicaProcess", "SpawnError",

@@ -61,6 +61,9 @@ class FleetTierConfig:
         self.unhealthy_after = 2
         self.wedged_after_s = 30.0
         self.retries = 3
+        self.channels_per_replica = 2
+        self.coalesce_ms = 0.0
+        self.coalesce_rows = 256
         self.spawn_timeout_s = 180.0
         self.scale_interval_s = 1.0
         self.scale_up_after_s = 2.0
@@ -105,6 +108,12 @@ class FleetTierConfig:
                 self.wedged_after_s = float(val)
             if name == "fleet_retries":
                 self.retries = int(val)
+            if name == "fleet_channels_per_replica":
+                self.channels_per_replica = int(val)
+            if name == "fleet_coalesce_ms":
+                self.coalesce_ms = float(val)
+            if name == "fleet_coalesce_rows":
+                self.coalesce_rows = int(val)
             if name == "fleet_spawn_timeout_s":
                 self.spawn_timeout_s = float(val)
             if name == "fleet_scale_interval_s":
@@ -147,6 +156,14 @@ class FleetTierConfig:
                 model_in = val
         if self.replicas < 1:
             raise ValueError("fleet_replicas must be >= 1")
+        if self.channels_per_replica < 0:
+            raise ValueError(
+                "fleet_channels_per_replica must be >= 0 "
+                "(0 = pooled v1 data path)")
+        if self.coalesce_ms < 0:
+            raise ValueError("fleet_coalesce_ms must be >= 0")
+        if self.coalesce_rows < 1:
+            raise ValueError("fleet_coalesce_rows must be >= 1")
         if not self.min_replicas:
             self.min_replicas = self.replicas
         if not self.max_replicas:

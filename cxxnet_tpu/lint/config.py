@@ -69,11 +69,30 @@ HOT_PATH_ROOTS = {
     # the fleet balancer's per-request path (doc/serving.md
     # "Horizontal fleet"): every fleet request funnels through
     # handle -> _route -> _forward, so a host sync added there taxes
-    # the whole fleet's latency, not one engine's
+    # the whole fleet's latency, not one engine's. The multiplexed
+    # data path (doc/serving.md "Fleet data path") adds the channel
+    # writer/reader loops (every forward's frames and replies cross
+    # them) and the coalescer flush + merged-forward chain — all
+    # steady-state per-request code. The same registrations anchor
+    # the CXL002 side: the loops are threading.Thread targets, so the
+    # lock-discipline closure already covers the state they share
+    # with submitting threads.
     "cxxnet_tpu/fleet/balancer.py": (
         "FleetBalancer.handle",
         "FleetBalancer._route",
         "FleetBalancer._forward",
+        "FleetBalancer._forward_merged",
+        "ReplicaChannel._writer_loop",
+        "ReplicaChannel._reader_loop",
+        "_Coalescer._flush_loop",
+    ),
+    # the replica-side v2 frame loop: request decode (zero-copy
+    # frombuffer view), async admission, and the out-of-order reply
+    # writer — the per-request path of every pipelined fleet forward
+    "cxxnet_tpu/serve/frontend.py": (
+        "_BinaryHandler.handle",
+        "_V2ConnState.complete",
+        "FleetServer.handle_async",
     ),
 }
 

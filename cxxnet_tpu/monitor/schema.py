@@ -80,7 +80,15 @@ REQUIRED: Dict[str, tuple] = {
     # promote/rollback record doubles as the schema-validated decision
     # record written to canary_out)
     "fleet_route": ("protocol", "status", "model", "tenant", "rows",
-                    "replica", "version", "retries", "latency_ms"),
+                    "replica", "version", "retries", "latency_ms",
+                    "coalesced", "channel"),
+    # one per coalesced super-batch forward (fleet_coalesce_ms > 0):
+    # how many client requests merged, the rows they carried, which
+    # replica/channel answered, and the forward wall time — the
+    # balancer-side twin of serve_batch (doc/serving.md "Fleet data
+    # path")
+    "fleet_batch": ("model", "replica", "status", "requests", "rows",
+                    "channel", "retries", "latency_ms"),
     "fleet_scale": ("action", "replicas", "ready", "reason"),
     "canary": ("phase", "baseline_version", "canary_version",
                "fraction", "reason"),

@@ -365,6 +365,24 @@ class DynamicBatcher:
         with self._stats:
             return self._lat.percentile(q)
 
+    def fill_stats(self) -> Dict[str, Any]:
+        """Cumulative micro-batch economics (batches, rows, bucket
+        rows, pad rows + derived fill/pad ratios) — exported through
+        the fleet ``/healthz`` so the multi-replica bench can report
+        pad fraction fleet-wide (doc/serving.md "Fleet data path")."""
+        with self._stats:
+            c = dict(self.counters)
+        return {
+            "batches": c["batches"],
+            "batch_rows": c["batch_rows"],
+            "bucket_rows": c["bucket_rows"],
+            "pad_rows": c["pad_rows"],
+            "fill_rate": c["batch_rows"]
+            / float(max(1, c["batches"] * self.max_batch)),
+            "pad_fraction": c["pad_rows"]
+            / float(max(1, c["bucket_rows"])),
+        }
+
     # -- shutdown --------------------------------------------------------
 
     def close(self, drain: bool = True,

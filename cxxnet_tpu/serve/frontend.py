@@ -57,16 +57,33 @@ from .swap import SnapshotWatcher, counter_of, latest_verified
 
 # -- binary protocol ------------------------------------------------------
 #
+# v1 (untagged, one round trip per in-flight request):
 # Request:  MAGIC | u8 model_len | u8 tenant_len | u32 nrows |
 #           u32 elems_per_row | f32 timeout_ms | model utf8 |
 #           tenant utf8 | nrows*elems float32 LE rows
 # Reply:    MAGIC | u8 status | u32 nrows | u32 elems_per_row |
 #           payload: float32 LE rows (status 0) or
 #           u32 msg_len + utf8 message (any other status)
+#
+# v2 (correlated, multiplexed): the same grammar under the CXN2 magic
+# with a u32 correlation id after the magic on both frames. Replies
+# carry the request's id and MAY arrive out of order, so one
+# persistent connection pipelines many in-flight requests (the fleet
+# balancer's ReplicaChannel, doc/serving.md "Fleet data path").
+# Negotiation is per-frame and stateless: a v2 frame gets a v2 reply,
+# an untagged v1 frame gets a v1 reply — old clients keep working
+# unchanged. A v2 request with nrows == elems == model_len ==
+# tenant_len == 0 is a PING: answered ok (0 rows) without touching
+# the request core — the connect-time probe a v2 client uses to
+# detect a v1-only server (which answers the unknown magic with a v1
+# bad_request frame and drops the connection).
 
 BIN_MAGIC = b"CXN1"
+BIN_MAGIC_V2 = b"CXN2"
 _REQ_HEADER = struct.Struct("<4sBBIIf")
 _REP_HEADER = struct.Struct("<4sBII")
+_REQ_HEADER_V2 = struct.Struct("<4sIBBIIf")
+_REP_HEADER_V2 = struct.Struct("<4sIBII")
 _MSG_LEN = struct.Struct("<I")
 
 # hard sanity caps on a single binary frame: a corrupt length prefix
@@ -130,6 +147,47 @@ def pack_reply(status: int, payload: np.ndarray = None,
             + _MSG_LEN.pack(len(msg)) + msg)
 
 
+def pack_request_v2(corr: int, model: str, tenant: str,
+                    rows: np.ndarray,
+                    timeout_ms: float = 0.0) -> bytes:
+    """Encode one protocol-v2 request frame (correlation-tagged)."""
+    rows = np.ascontiguousarray(rows, dtype="<f4")
+    if rows.ndim == 1:
+        rows = rows[None, :]
+    flat = rows.reshape(rows.shape[0], -1)
+    m, t = model.encode(), tenant.encode()
+    if len(m) > 255 or len(t) > 255:
+        raise ValueError("model/tenant ids are limited to 255 bytes")
+    return (_REQ_HEADER_V2.pack(BIN_MAGIC_V2, corr, len(m), len(t),
+                                flat.shape[0], flat.shape[1],
+                                float(timeout_ms))
+            + m + t + flat.tobytes())
+
+
+def pack_ping_v2(corr: int = 0) -> bytes:
+    """The v2 PING frame (zero rows, zero ids): answered ok without
+    touching the request core — the negotiation probe."""
+    return _REQ_HEADER_V2.pack(BIN_MAGIC_V2, corr, 0, 0, 0, 0, 0.0)
+
+
+def pack_reply_v2(corr: int, status: int, payload: np.ndarray = None,
+                  message: str = "") -> bytes:
+    """Encode one protocol-v2 reply frame. ``payload is None`` with
+    an ok status encodes the zero-row pong."""
+    if status == STATUS_OK:
+        if payload is None:
+            return _REP_HEADER_V2.pack(BIN_MAGIC_V2, corr, status,
+                                       0, 0)
+        flat = np.ascontiguousarray(payload, dtype="<f4")
+        flat = flat.reshape(flat.shape[0], -1)
+        return (_REP_HEADER_V2.pack(BIN_MAGIC_V2, corr, status,
+                                    flat.shape[0], flat.shape[1])
+                + flat.tobytes())
+    msg = message.encode()
+    return (_REP_HEADER_V2.pack(BIN_MAGIC_V2, corr, status, 0, 0)
+            + _MSG_LEN.pack(len(msg)) + msg)
+
+
 def _read_exact(rfile, n: int) -> Optional[bytes]:
     """Read exactly n bytes; None on clean EOF at a frame boundary."""
     buf = b""
@@ -141,14 +199,10 @@ def _read_exact(rfile, n: int) -> Optional[bytes]:
     return buf
 
 
-def read_reply(rfile) -> Tuple[str, Any]:
-    """Read one reply frame -> (status_name, rows | message)."""
-    hdr = _read_exact(rfile, _REP_HEADER.size)
-    if hdr is None or len(hdr) < _REP_HEADER.size:
-        raise IOError("connection closed mid-reply")
-    magic, status, nrows, elems = _REP_HEADER.unpack(hdr)
-    if magic != BIN_MAGIC:
-        raise IOError("bad reply magic %r" % magic)
+def _read_reply_payload(rfile, status: int, nrows: int,
+                        elems: int) -> Tuple[str, Any]:
+    """Read a reply frame's payload (shared by both protocol
+    versions) -> (status_name, rows | message)."""
     name = STATUS_NAMES.get(status, "error")
     if status == STATUS_OK:
         payload = _read_exact(rfile, nrows * elems * 4)
@@ -162,6 +216,43 @@ def read_reply(rfile) -> Tuple[str, Any]:
     mlen = _MSG_LEN.unpack(raw)[0]
     msg = _read_exact(rfile, mlen) if mlen else b""
     return name, (msg or b"").decode(errors="replace")
+
+
+def read_reply(rfile) -> Tuple[str, Any]:
+    """Read one v1 reply frame -> (status_name, rows | message)."""
+    hdr = _read_exact(rfile, _REP_HEADER.size)
+    if hdr is None or len(hdr) < _REP_HEADER.size:
+        raise IOError("connection closed mid-reply")
+    magic, status, nrows, elems = _REP_HEADER.unpack(hdr)
+    if magic != BIN_MAGIC:
+        raise IOError("bad reply magic %r" % magic)
+    return _read_reply_payload(rfile, status, nrows, elems)
+
+
+def read_reply_tagged(rfile) -> Tuple[Optional[int], str, Any]:
+    """Read one reply frame of EITHER protocol version ->
+    (corr_id, status_name, rows | message); a v1 frame reports
+    ``corr_id = None`` — how a v2 client's negotiation probe detects
+    a v1-only server."""
+    magic = _read_exact(rfile, 4)
+    if magic is None or len(magic) < 4:
+        raise IOError("connection closed mid-reply")
+    if magic == BIN_MAGIC:
+        rest = _read_exact(rfile, _REP_HEADER.size - 4)
+        if rest is None or len(rest) < _REP_HEADER.size - 4:
+            raise IOError("connection closed mid-reply")
+        _, status, nrows, elems = _REP_HEADER.unpack(magic + rest)
+        name, payload = _read_reply_payload(rfile, status, nrows,
+                                            elems)
+        return None, name, payload
+    if magic != BIN_MAGIC_V2:
+        raise IOError("bad reply magic %r" % magic)
+    rest = _read_exact(rfile, _REP_HEADER_V2.size - 4)
+    if rest is None or len(rest) < _REP_HEADER_V2.size - 4:
+        raise IOError("connection closed mid-reply")
+    _, corr, status, nrows, elems = _REP_HEADER_V2.unpack(magic + rest)
+    name, payload = _read_reply_payload(rfile, status, nrows, elems)
+    return corr, name, payload
 
 
 class BinaryClient:
@@ -404,7 +495,7 @@ class FleetServer:
     def _shape_rows(self, entry, rows) -> np.ndarray:
         """Coerce client rows (flat or natural layout) to the served
         instance shape; mismatches bounce as bad_request."""
-        arr = np.asarray(rows, dtype=np.float32)
+        arr = np.asarray(rows, dtype=np.float32)  # cxxlint: disable=CXL003 -- protocol admission: client rows arrive as host bytes/JSON; the binary path's <f4 frombuffer view passes through copy-free and there is no device value to keep resident
         inst = entry.session.engine._inst_shape()
         elems = int(np.prod(inst))
         if arr.ndim == 1 and arr.size == elems:
@@ -418,6 +509,151 @@ class FleetServer:
                 "shape %r (%d values per row)"
                 % (tuple(arr.shape), inst, elems))
         return arr
+
+    def handle_async(self, model_id: str, tenant: str, rows,
+                     protocol: str = "binary",
+                     timeout_ms: Optional[float] = None,
+                     done=None) -> None:
+        """Non-blocking twin of :meth:`handle` — the out-of-order
+        reply path of the v2 binary protocol (doc/serving.md "Fleet
+        data path"). Admission (routing, shape, quota) runs inline on
+        the caller's thread; the dispatch rides the batcher's Future.
+        ``done(status, result, extra)`` fires exactly once — inline
+        for admission failures, from a serve worker thread otherwise
+        — and, like ``handle``, this never raises."""
+        t0 = time.monotonic()
+        state = {"nrows": 0, "model": model_id}
+
+        def finish(status, result, extra):
+            self._record(protocol, status, state["model"], tenant,
+                         state["nrows"], t0)
+            done(status, result, extra)
+
+        try:
+            entry = self.router.resolve(model_id)
+            state["model"] = entry.model_id
+            arr = self._shape_rows(entry, rows)
+            state["nrows"] = arr.shape[0]
+            try:
+                self.quota.admit(tenant, state["nrows"])
+            except TenantQuotaError as e:
+                self._emit("tenant_shed", tenant=tenant,
+                           model=state["model"], rows=state["nrows"],
+                           rate=e.rate, burst=e.burst,
+                           retry_after_s=round(e.retry_after_s, 3))
+                raise
+        except TenantQuotaError as e:
+            finish("over_quota", str(e),
+                   {"retry_after_s": e.retry_after_s})
+            return
+        except UnknownModelError as e:
+            finish("unknown_model", str(e.args[0]), {})
+            return
+        except (ValueError, TypeError) as e:
+            finish("bad_request", str(e), {})
+            return
+        except Exception as e:   # an admission bug must answer, not hang
+            finish("error", str(e), {})
+            return
+        # a super-batch wider than one dispatch (the balancer's
+        # coalesced forwards) splits into max_batch chunks and
+        # reassembles — the dispatcher re-coalesces chunks onto the
+        # bucket ladder, so an oversized request costs ceil(n/mb)
+        # submits, not a bad_request bounce
+        mb = entry.session.engine.max_batch
+        if state["nrows"] > mb:
+            self._dispatch_chunked(state["model"], arr, mb,
+                                   timeout_ms, finish)
+        else:
+            self._dispatch_async(state["model"], arr, timeout_ms,
+                                 finish, attempts=8)
+
+    def _dispatch_chunked(self, model_id: str, arr: np.ndarray,
+                          max_batch: int,
+                          timeout_ms: Optional[float],
+                          finish) -> None:
+        """Fan an oversized row array out as max_batch-sized chunks
+        and call ``finish`` once with the reassembled rows (or the
+        first non-ok status)."""
+        chunks = [arr[i:i + max_batch]
+                  for i in range(0, arr.shape[0], max_batch)]
+        results: List[Any] = [None] * len(chunks)
+        state = {"pending": len(chunks), "failed": None}
+        lock = threading.Lock()
+
+        def chunk_finish(idx):
+            def _finish(status, result, extra):
+                with lock:
+                    if status == "ok":
+                        results[idx] = result
+                    elif state["failed"] is None:
+                        state["failed"] = (status, result, extra)
+                    state["pending"] -= 1
+                    last = state["pending"] == 0
+                if not last:
+                    return
+                if state["failed"] is not None:
+                    finish(*state["failed"])
+                else:
+                    finish("ok", np.concatenate(
+                        [np.asarray(r) for r in results]), {})
+            return _finish
+
+        for i, chunk in enumerate(chunks):
+            self._dispatch_async(model_id, chunk, timeout_ms,
+                                 chunk_finish(i), attempts=8)
+
+    def _dispatch_async(self, model_id: str, arr: np.ndarray,
+                        timeout_ms: Optional[float], finish,
+                        attempts: int) -> None:
+        """Submit through the CURRENT session and chain ``finish``
+        onto the batcher Future; the hot-swap ``ServeClosedError``
+        race retries through a fresh resolve exactly like
+        ``_predict_with_retry`` (the 1 ms settle runs on the retiring
+        session's worker, off the request path)."""
+        try:
+            entry = self.router.resolve(model_id)
+            fut = entry.session.submit(arr, timeout_ms)
+        except ServeClosedError as e:
+            if not self._closing and attempts > 1:
+                time.sleep(0.001)   # let the flip commit, then re-resolve
+                self._dispatch_async(model_id, arr, timeout_ms,
+                                     finish, attempts - 1)
+            else:
+                finish("closed", str(e), {})
+            return
+        except ServeBusyError as e:
+            finish("busy", str(e), {})
+            return
+        except ServeTimeoutError as e:
+            finish("timeout", str(e), {})
+            return
+        except (ValueError, TypeError) as e:
+            finish("bad_request", str(e), {})
+            return
+        except Exception as e:
+            finish("error", str(e), {})
+            return
+
+        def _done(f):
+            exc = f.exception()
+            if exc is None:
+                finish("ok", f.result(), {})
+            elif isinstance(exc, ServeClosedError) \
+                    and not self._closing and attempts > 1:
+                time.sleep(0.001)
+                self._dispatch_async(model_id, arr, timeout_ms,
+                                     finish, attempts - 1)
+            elif isinstance(exc, ServeBusyError):
+                finish("busy", str(exc), {})
+            elif isinstance(exc, ServeTimeoutError):
+                finish("timeout", str(exc), {})
+            elif isinstance(exc, ServeClosedError):
+                finish("closed", str(exc), {})
+            else:
+                finish("error", str(exc), {})
+
+        fut.add_done_callback(_done)
 
     def _predict_with_retry(self, model_id: str, arr: np.ndarray,
                             timeout_ms: Optional[float]) -> np.ndarray:
@@ -522,7 +758,7 @@ class FleetServer:
             queue_rows += m_queue
             p99 = max(p99, m_p99)
             snap = e.session.engine.counters_snapshot()
-            models.append({
+            row = {
                 "model": e.model_id, "counter": e.counter,
                 "generation": e.generation,
                 "max_batch": e.session.engine.max_batch,
@@ -530,7 +766,12 @@ class FleetServer:
                 "p99_ms": round(m_p99, 3),
                 "compile_events": snap["compile_events"],
                 "aot_hits": snap["aot_hits"],
-            })
+            }
+            # cumulative batch economics (fill/pad): what the fleet
+            # bench aggregates across replicas (doc/serving.md "Fleet
+            # data path")
+            row.update(batcher.fill_stats())
+            models.append(row)
         return {
             "ok": True, "pid": os.getpid(),
             "models": self.router.ids(),
@@ -706,45 +947,195 @@ class _FleetBinaryServer(socketserver.ThreadingTCPServer):
         super().__init__(addr, handler)
 
 
+class _V2ConnState:
+    """Out-of-order reply half of one v2 binary connection:
+    completion callbacks frame (corr, status, result) straight onto
+    the socket in COMPLETION order, serialized by a write lock — a
+    slow request never blocks the replies behind it (no head-of-line
+    blocking), and a completed reply reaches the wire with no thread
+    hop (a dedicated reply thread measured as a p99 convoy under GIL
+    pressure: every reply of the connection serialized behind one
+    thread's scheduling). The write into the kernel socket buffer is
+    microseconds for these frames; ``finish()`` holds teardown until
+    the in-flight requests have answered."""
+
+    def __init__(self, wfile, wlock):
+        self._wfile = wfile
+        # the CONNECTION's write lock, shared with the handler's v1
+        # reply writes: per-frame negotiation allows v1 and v2 frames
+        # interleaved on one connection, and a v1 reply on the handler
+        # thread must not interleave bytes with a concurrent v2
+        # completion write
+        self._wlock = wlock
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._drained = threading.Condition(self._lock)
+
+    def begin(self) -> None:
+        with self._lock:
+            self._pending += 1
+
+    def reply(self, corr: int, status: str, result) -> None:
+        """Immediate reply (pings, inline admission failures answered
+        through complete() instead — this one does not pair with a
+        begin())."""
+        self._write(corr, status, result)
+
+    def complete(self, corr: int, status: str, result) -> None:
+        self._write(corr, status, result)
+        with self._lock:
+            self._pending -= 1
+            if self._pending == 0:
+                self._drained.notify_all()
+
+    def finish(self) -> None:
+        """Read loop done (EOF/torn frame): wait for the in-flight
+        requests to answer before the connection tears down."""
+        with self._lock:
+            deadline = time.monotonic() + 60
+            while self._pending > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._drained.wait(remaining)
+
+    def _write(self, corr: int, status: str, result) -> None:
+        try:
+            if status == "ok":
+                frame = pack_reply_v2(corr, STATUS_OK, payload=result)
+            else:
+                frame = pack_reply_v2(corr, STATUS_CODES[status],
+                                      message=str(result))
+            with self._wlock:
+                self._wfile.write(frame)
+        except (OSError, ValueError):
+            # client went away mid-stream: there is no one to answer,
+            # but the in-flight accounting must still drain
+            pass  # cxxlint: disable=CXL006 -- the reply has no recipient; the caller's complete() keeps shutdown bounded
+
+
 class _BinaryHandler(socketserver.StreamRequestHandler):
-    """Persistent connection: one request frame in, one reply frame
-    out, until the client closes. A malformed frame answers
-    bad_request and drops the connection (a desynced length-prefixed
-    stream cannot be re-synchronized)."""
+    """Persistent connection, both protocol versions per frame: an
+    untagged v1 frame gets the classic one-in-one-out round trip; a
+    correlation-tagged v2 frame is dispatched asynchronously and its
+    reply may overtake slower neighbors (out-of-order, pipelined). A
+    malformed frame answers bad_request and drops the connection (a
+    desynced length-prefixed stream cannot be re-synchronized)."""
 
     def handle(self):
         fleet = self.server.fleet
-        while True:
-            hdr = _read_exact(self.rfile, _REQ_HEADER.size)
-            if hdr is None:
-                return                        # clean EOF between frames
-            if len(hdr) < _REQ_HEADER.size:
-                return                        # torn header: drop
-            magic, mlen, tlen, nrows, elems, timeout_ms = \
-                _REQ_HEADER.unpack(hdr)
-            if (magic != BIN_MAGIC or nrows > MAX_FRAME_ROWS
-                    or nrows * max(1, elems) * 4 > MAX_FRAME_BYTES):
-                self.wfile.write(pack_reply(
-                    STATUS_BAD_REQUEST,
-                    message="bad frame header (magic %r, %d x %d)"
-                    % (magic, nrows, elems)))
-                return
-            body = _read_exact(self.rfile,
-                               mlen + tlen + nrows * elems * 4)
-            if body is None or len(body) < mlen + tlen + \
-                    nrows * elems * 4:
-                return                        # torn body: drop
-            model = body[:mlen].decode(errors="replace")
-            tenant = body[mlen:mlen + tlen].decode(errors="replace")
-            rows = np.frombuffer(body[mlen + tlen:],
-                                 "<f4").reshape(nrows, elems) \
-                if nrows else np.zeros((0, max(1, elems)), np.float32)
+        self._v2 = None
+        # one write lock per connection: v1 replies (handler thread)
+        # and v2 completion writes (worker threads) share the socket
+        self._wlock = threading.Lock()
+        try:
+            while True:
+                magic = _read_exact(self.rfile, 4)
+                if magic is None or len(magic) < 4:
+                    return                    # EOF (torn magic: drop)
+                if magic == BIN_MAGIC:
+                    if not self._handle_v1(fleet, magic):
+                        return
+                elif magic == BIN_MAGIC_V2:
+                    if not self._handle_v2(fleet, magic):
+                        return
+                else:
+                    self._write_v1(pack_reply(
+                        STATUS_BAD_REQUEST,
+                        message="bad frame magic %r" % magic))
+                    return
+        finally:
+            if self._v2 is not None:
+                self._v2.finish()
+
+    def _write_v1(self, frame: bytes) -> None:
+        with self._wlock:
+            self.wfile.write(frame)
+
+    def _read_frame(self, magic: bytes):
+        """Read one request frame after its magic; returns
+        (corr, model, tenant, rows, timeout_ms) or an error string,
+        or None on a torn stream (drop silently)."""
+        v2 = magic == BIN_MAGIC_V2
+        header = _REQ_HEADER_V2 if v2 else _REQ_HEADER
+        rest = _read_exact(self.rfile, header.size - 4)
+        if rest is None or len(rest) < header.size - 4:
+            return None
+        if v2:
+            _, corr, mlen, tlen, nrows, elems, timeout_ms = \
+                header.unpack(magic + rest)
+        else:
+            corr = None
+            _, mlen, tlen, nrows, elems, timeout_ms = \
+                header.unpack(magic + rest)
+        if nrows > MAX_FRAME_ROWS \
+                or nrows * max(1, elems) * 4 > MAX_FRAME_BYTES:
+            return "bad frame header (%d x %d)" % (nrows, elems)
+        if v2 and nrows == 0 and elems == 0 and mlen == 0 \
+                and tlen == 0:
+            return ("ping", corr)
+        body = _read_exact(self.rfile,
+                           mlen + tlen + nrows * elems * 4)
+        if body is None or len(body) < mlen + tlen + nrows * elems * 4:
+            return None
+        model = body[:mlen].decode(errors="replace")
+        tenant = body[mlen:mlen + tlen].decode(errors="replace")
+        # zero-copy ingress: the frame's row bytes become a read-only
+        # float32 VIEW (frombuffer at an offset — a bytes slice would
+        # copy the whole payload) the engine's staging ring copies
+        # from exactly once (client bytes -> H2D source)
+        rows = np.frombuffer(body, "<f4",
+                             offset=mlen + tlen).reshape(nrows,
+                                                         elems) \
+            if nrows else np.zeros((0, max(1, elems)), np.float32)
+        return corr, model, tenant, rows, timeout_ms
+
+    def _handle_v1(self, fleet, magic: bytes) -> bool:
+        frame = self._read_frame(magic)
+        if frame is None:
+            return False
+        if isinstance(frame, str):   # pings are v2-only
+            self._write_v1(pack_reply(STATUS_BAD_REQUEST,
+                                      message=frame))
+            return False
+        _, model, tenant, rows, timeout_ms = frame
+        status, result, _ = fleet.handle(
+            model, tenant, rows, protocol="binary",
+            timeout_ms=timeout_ms if timeout_ms > 0 else None)
+        if status == "ok":
+            self._write_v1(pack_reply(STATUS_OK, payload=result))
+        else:
+            self._write_v1(pack_reply(STATUS_CODES[status],
+                                      message=str(result)))
+        return True
+
+    def _handle_v2(self, fleet, magic: bytes) -> bool:
+        frame = self._read_frame(magic)
+        if frame is None:
+            return False
+        if self._v2 is None:
+            self._v2 = _V2ConnState(self.wfile, self._wlock)
+        if isinstance(frame, str):
+            self._v2.reply(0, "bad_request", frame)
+            return False
+        if frame[0] == "ping":
+            # pong without touching the core (the negotiation probe,
+            # and the deterministic out-of-order witness in tests)
+            self._v2.reply(frame[1], "ok", None)
+            return True
+        corr, model, tenant, rows, timeout_ms = frame
+        st = self._v2
+        st.begin()
+        if hasattr(fleet, "handle_async"):
+            fleet.handle_async(
+                model, tenant, rows, protocol="binary",
+                timeout_ms=timeout_ms if timeout_ms > 0 else None,
+                done=lambda s, r, e, c=corr: st.complete(c, s, r))
+        else:
+            # a core without an async surface (the balancer) answers
+            # v2 frames in order — correlation ids still correct
             status, result, _ = fleet.handle(
                 model, tenant, rows, protocol="binary",
                 timeout_ms=timeout_ms if timeout_ms > 0 else None)
-            if status == "ok":
-                self.wfile.write(pack_reply(STATUS_OK,
-                                            payload=result))
-            else:
-                self.wfile.write(pack_reply(STATUS_CODES[status],
-                                            message=str(result)))
+            st.complete(corr, status, result)
+        return True

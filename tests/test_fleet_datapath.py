@@ -1,0 +1,448 @@
+"""Fleet hot-path data plane (PR 13): protocol v2 correlated frames,
+out-of-order pipelined replies, multiplexed ReplicaChannels,
+balancer-side coalescing with per-request split, zero-copy relay
+semantics, the pooled-path connection-leak fix, and the rotating
+_pick tiebreak."""
+
+import io
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.fleet import (FleetBalancer, FleetTierConfig,
+                              ReplicaChannel, ReplicaV1Only)
+from cxxnet_tpu.monitor import MemorySink, Monitor
+from cxxnet_tpu.monitor.schema import validate_records
+from cxxnet_tpu.serve import FleetServer
+from cxxnet_tpu.serve.frontend import (BinaryClient, pack_ping_v2,
+                                       pack_reply_v2, pack_request,
+                                       pack_request_v2,
+                                       read_reply_tagged)
+from cxxnet_tpu.utils.config import parse_config
+
+from test_fleet import FLEET_MLP_CONF, _save_mlp_snapshot
+
+
+# -- pure: v2 frame grammar ------------------------------------------------
+
+
+def test_v2_reply_roundtrip_and_v1_tagging():
+    rows = np.arange(8, dtype=np.float32).reshape(2, 4)
+    buf = io.BytesIO(pack_reply_v2(42, 0, payload=rows))
+    corr, status, out = read_reply_tagged(buf)
+    assert corr == 42 and status == "ok"
+    np.testing.assert_array_equal(out, rows)
+    # error replies carry the message; pongs carry zero rows
+    buf = io.BytesIO(pack_reply_v2(7, 1, message="busy now"))
+    assert read_reply_tagged(buf) == (7, "busy", "busy now")
+    buf = io.BytesIO(pack_reply_v2(9, 0, payload=None))
+    corr, status, out = read_reply_tagged(buf)
+    assert corr == 9 and status == "ok" and out.shape == (0, 0)
+    # a v1 frame reads back with corr None — the negotiation signal
+    from cxxnet_tpu.serve.frontend import pack_reply
+    buf = io.BytesIO(pack_reply(0, payload=rows))
+    corr, status, out = read_reply_tagged(buf)
+    assert corr is None and status == "ok"
+    np.testing.assert_array_equal(out, rows)
+    with pytest.raises(ValueError):
+        pack_request_v2(1, "m" * 256, "", rows)
+
+
+def test_fleet_tier_config_datapath_keys():
+    c = FleetTierConfig([("model_in", "x")])
+    assert c.channels_per_replica == 2
+    assert c.coalesce_ms == 0.0 and c.coalesce_rows == 256
+    c = FleetTierConfig([("model_in", "x"),
+                         ("fleet_channels_per_replica", "0"),
+                         ("fleet_coalesce_ms", "2.5"),
+                         ("fleet_coalesce_rows", "64")])
+    assert c.channels_per_replica == 0
+    assert c.coalesce_ms == 2.5 and c.coalesce_rows == 64
+    with pytest.raises(ValueError):
+        FleetTierConfig([("model_in", "x"),
+                         ("fleet_channels_per_replica", "-1")])
+    with pytest.raises(ValueError):
+        FleetTierConfig([("model_in", "x"),
+                         ("fleet_coalesce_ms", "-1")])
+    with pytest.raises(ValueError):
+        FleetTierConfig([("model_in", "x"),
+                         ("fleet_coalesce_rows", "0")])
+
+
+# -- live replica front end ------------------------------------------------
+
+
+def _mk_server(snap, max_delay_ms="20"):
+    cfg = parse_config(FLEET_MLP_CONF) + [
+        ("serve_models", "default=%s" % snap),
+        ("serve_http_port", "0"), ("serve_binary_port", "0"),
+        ("serve_swap_poll_s", "0"),
+        ("serve_max_delay_ms", max_delay_ms),
+        ("serve_queue_rows", "4096"),
+    ]
+    server = FleetServer(cfg)
+    server.start()
+    return server
+
+
+@pytest.fixture(scope="module")
+def dp_env(tmp_path_factory):
+    """One snapshot + one live v2 FleetServer + its reference
+    outputs, shared by the data-path tests."""
+    tmp = tmp_path_factory.mktemp("fleet_dp")
+    snap = tmp / "0001.model.npz"
+    _save_mlp_snapshot(snap)
+    server = _mk_server(snap)
+    yield server, snap
+    server.close()
+
+
+def test_v1_client_against_v2_frontend(dp_env):
+    """Untagged v1 frames keep working against the upgraded front
+    end — including interleaved with v2 frames on ONE connection."""
+    server, _ = dp_env
+    rows = np.random.RandomState(3).rand(2, 64).astype(np.float32)
+    bc = BinaryClient("127.0.0.1", server.binary_port)
+    try:
+        status, ref = bc.predict(rows)
+        assert status == "ok" and ref.shape == (2, 4)
+    finally:
+        bc.close()
+    s = socket.create_connection(("127.0.0.1", server.binary_port),
+                                 timeout=30)
+    rf = s.makefile("rb")
+    try:
+        # v1 frame, then a v2 frame, then v1 again — per-frame
+        # negotiation, no connection state
+        s.sendall(pack_request("", "", rows))
+        corr, status, out = read_reply_tagged(rf)
+        assert corr is None and status == "ok"
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        s.sendall(pack_request_v2(11, "", "", rows))
+        corr, status, out = read_reply_tagged(rf)
+        assert corr == 11 and status == "ok"
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        s.sendall(pack_request("", "", rows))
+        corr, status, out = read_reply_tagged(rf)
+        assert corr is None and status == "ok"
+    finally:
+        rf.close()
+        s.close()
+
+
+def test_v2_replies_out_of_order_and_pipelined(dp_env):
+    """The tentpole protocol contract: one connection, many in-flight
+    requests, replies by correlation id in COMPLETION order — a ping
+    behind a queued predict overtakes it deterministically, and N
+    back-to-back predicts all answer (and coalesce server-side,
+    proving they were in flight concurrently)."""
+    server, _ = dp_env
+    rows = np.random.RandomState(4).rand(1, 64).astype(np.float32)
+    s = socket.create_connection(("127.0.0.1", server.binary_port),
+                                 timeout=30)
+    rf = s.makefile("rb")
+    try:
+        # predict (corr 7) waits out the 20 ms batch window; the ping
+        # (corr 9) never touches the core — its reply must overtake
+        s.sendall(pack_request_v2(7, "", "", rows))
+        s.sendall(pack_ping_v2(9))
+        first = read_reply_tagged(rf)
+        second = read_reply_tagged(rf)
+        assert first[0] == 9 and first[1] == "ok"
+        assert second[0] == 7 and second[1] == "ok"
+        # pipelining: 16 frames before reading a single reply
+        before = server.router.resolve("").session.batcher.counters[
+            "batches"]
+        for i in range(16):
+            s.sendall(pack_request_v2(100 + i, "", "", rows))
+        got = set()
+        for _ in range(16):
+            corr, status, out = read_reply_tagged(rf)
+            assert status == "ok", (corr, status, out)
+            got.add(corr)
+        assert got == set(range(100, 116))
+        after = server.router.resolve("").session.batcher.counters[
+            "batches"]
+        # concurrent in-flight requests coalesce into fewer
+        # micro-batches than requests — the pipelining witness (a v1
+        # client doing 16 round trips would pay ~16 batches)
+        assert after - before < 16
+    finally:
+        rf.close()
+        s.close()
+
+
+def test_replica_channel_submits_concurrently(dp_env):
+    """ReplicaChannel against a live replica: concurrent submits over
+    ONE socket all resolve correctly and the in-flight map actually
+    holds several entries at once (true pipelining, no head-of-line
+    blocking)."""
+    server, _ = dp_env
+    rng = np.random.RandomState(5)
+    ch = ReplicaChannel("127.0.0.1", server.binary_port)
+    try:
+        reqs = []
+        for i in range(12):
+            arr = rng.rand(1, 64).astype("<f4")
+            fut = ch.submit("", "", [memoryview(arr).cast("B")],
+                            1, 64, 0.0, 30.0)
+            reqs.append((arr, fut))
+        for arr, fut in reqs:
+            status, out = fut.result(timeout=30)
+            assert status == "ok" and out.shape == (1, 4)
+        assert ch.max_depth > 1
+        assert ch.depth() == 0
+    finally:
+        ch.close()
+
+
+def test_replica_channel_break_fails_inflight_as_retryable(dp_env):
+    """A torn channel fails every in-flight future with
+    ReplicaUnreachable (the idempotent-retry signal), and later
+    submits refuse fast."""
+    from cxxnet_tpu.fleet import ReplicaUnreachable
+    server, _ = dp_env
+    ch = ReplicaChannel("127.0.0.1", server.binary_port)
+    arr = np.zeros((1, 64), "<f4")
+    fut = ch.submit("", "", [memoryview(arr).cast("B")], 1, 64,
+                    0.0, 30.0)
+    ch.close()
+    with pytest.raises(ReplicaUnreachable):
+        # the in-flight future may have resolved ok before the close
+        # landed — only an unresolved one must fail as retryable
+        status, _ = fut.result(timeout=5)
+        raise ReplicaUnreachable("resolved ok before close: %s"
+                                 % status)
+    with pytest.raises(ReplicaUnreachable):
+        ch.submit("", "", [memoryview(arr).cast("B")], 1, 64,
+                  0.0, 30.0)
+
+
+# -- balancer data path ----------------------------------------------------
+
+
+def _mk_balancer(reps, pairs=(), monitor=None):
+    # listeners stay unbound (start() is never called — these tests
+    # drive bal.handle directly); the config only needs one enabled
+    tier_pairs = [("model_in", "unused.npz"),
+                  ("fleet_http_port", "-1"),
+                  ("fleet_binary_port", "0"),
+                  ("fleet_health_poll_s", "5")] + list(pairs)
+    bal = FleetBalancer(FleetTierConfig(tier_pairs), tier_pairs,
+                        monitor=monitor)
+    for i, r in enumerate(reps):
+        bal.add_replica("r%d" % i, "127.0.0.1", r.http_port,
+                        r.binary_port, "v1")
+    return bal
+
+
+def test_balancer_routes_over_channels(dp_env):
+    server, _ = dp_env
+    sink = MemorySink()
+    bal = _mk_balancer([server], monitor=Monitor(sink))
+    try:
+        rows = np.random.RandomState(6).rand(2, 64) \
+            .astype(np.float32)
+        status, out, _ = bal.handle("", "gold", rows)
+        assert status == "ok" and np.asarray(out).shape == (2, 4)
+        routes = [r for r in sink.records
+                  if r["event"] == "fleet_route"]
+        assert routes[-1]["channel"] >= 0    # rode a multiplexed channel
+        assert routes[-1]["coalesced"] == 1
+        w = bal.take_window()
+        assert w["forwards"] == 1 and w["coalesce_fill"] == 1.0
+        assert "channel_depth" in w
+        assert validate_records(sink.records, strict=False) == []
+    finally:
+        bal.close()
+
+
+def test_balancer_v1_fallback_via_negotiation(dp_env, monkeypatch):
+    """A replica that answers the probe with a v1 frame downgrades to
+    the pooled path (channel = -1 in telemetry) and keeps serving."""
+    server, _ = dp_env
+    monkeypatch.setattr(
+        "cxxnet_tpu.fleet.balancer.ReplicaChannel",
+        _raise_v1only)
+    sink = MemorySink()
+    bal = _mk_balancer([server], monitor=Monitor(sink))
+    try:
+        rows = np.zeros((1, 64), np.float32)
+        status, out, _ = bal.handle("", "t", rows)
+        assert status == "ok"
+        with bal._lock:
+            assert bal._reps["r0"].v1_only
+        routes = [r for r in sink.records
+                  if r["event"] == "fleet_route"]
+        assert routes[-1]["channel"] == -1
+        # and it stays on the pooled path without re-probing
+        status, _, _ = bal.handle("", "t", rows)
+        assert status == "ok"
+    finally:
+        bal.close()
+
+
+def _raise_v1only(*a, **k):
+    raise ReplicaV1Only("forced v1")
+
+
+def test_pooled_forward_releases_or_discards_on_protocol_error(
+        dp_env, monkeypatch):
+    """The PR 11 leak: a non-OSError out of client.predict (e.g. a
+    protocol ValueError from a malformed reply) skipped both release
+    and close, permanently losing the pool slot and the socket. Now
+    every exit releases-or-discards."""
+    server, _ = dp_env
+    bal = _mk_balancer([server],
+                       pairs=[("fleet_channels_per_replica", "0")])
+    try:
+        rows = np.zeros((1, 64), np.float32)
+        assert bal.handle("", "t", rows)[0] == "ok"
+        with bal._lock:
+            rep = bal._reps["r0"]
+        assert len(rep._pool) == 1           # connection back in the pool
+        pooled = rep._pool[0]
+
+        def bad_predict(self, *a, **k):
+            raise ValueError("malformed reply: negative row count")
+
+        monkeypatch.setattr(BinaryClient, "predict", bad_predict)
+        status, msg, _ = bal.handle("", "t", rows)
+        assert status == "bad_request" and "malformed" in msg
+        monkeypatch.undo()
+        # the poisoned connection was DISCARDED (closed, not pooled)
+        assert rep._pool == []
+        assert pooled.sock.fileno() == -1    # actually closed
+        # and the pool recovers with a fresh connection
+        assert bal.handle("", "t", rows)[0] == "ok"
+        assert len(rep._pool) == 1
+        assert rep._pool[0] is not pooled
+    finally:
+        bal.close()
+
+
+def test_pick_rotates_ties_at_idle(dp_env):
+    """Equal-load replicas must share cold-start traffic instead of
+    convoying on the lexicographically-first id."""
+    server, _ = dp_env
+    bal = _mk_balancer([server, server, server])
+    try:
+        picks = [bal._pick(set()).replica_id for _ in range(30)]
+        counts = {rid: picks.count(rid) for rid in set(picks)}
+        assert set(counts) == {"r0", "r1", "r2"}
+        assert all(c == 10 for c in counts.values()), counts
+    finally:
+        bal.close()
+
+
+def test_coalescer_merges_splits_and_answers_each_request(dp_env):
+    """Concurrent single-row requests within the window forward as
+    one super-batch and every request gets ITS rows back (split by
+    offset), with the merge visible in fleet_route.coalesced and a
+    fleet_batch record."""
+    server, snap = dp_env
+    rng = np.random.RandomState(7)
+    reqs = [rng.rand(1, 64).astype(np.float32) for _ in range(8)]
+    bc = BinaryClient("127.0.0.1", server.binary_port)
+    try:
+        refs = [np.asarray(bc.predict(r)[1]) for r in reqs]
+    finally:
+        bc.close()
+    sink = MemorySink()
+    bal = _mk_balancer([server],
+                       pairs=[("fleet_coalesce_ms", "30")],
+                       monitor=Monitor(sink))
+    try:
+        results = [None] * len(reqs)
+
+        def call(i):
+            results[i] = bal.handle("", "t", reqs[i])
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        for i, (status, out, _) in enumerate(results):
+            assert status == "ok", results[i]
+            np.testing.assert_allclose(np.asarray(out), refs[i],
+                                       rtol=1e-5, atol=1e-6)
+        routes = [r for r in sink.records
+                  if r["event"] == "fleet_route"]
+        assert max(r["coalesced"] for r in routes) > 1
+        merged = [r for r in sink.records
+                  if r["event"] == "fleet_batch"]
+        assert merged and max(r["requests"] for r in merged) > 1
+        assert sum(r["rows"] for r in merged) == len(reqs)
+        assert validate_records(sink.records, strict=False) == []
+    finally:
+        bal.close()
+
+
+def test_coalesced_replica_loss_zero_dropped_zero_duplicated(
+        tmp_path):
+    """Kill a replica mid-traffic on the coalesced/pipelined path:
+    every request answers ok (zero dropped) and every answer is the
+    requester's OWN rows (zero duplicated / mis-split rows across the
+    whole-merged-batch retry)."""
+    snap = tmp_path / "0001.model.npz"
+    _save_mlp_snapshot(snap)
+    reps = [_mk_server(snap, max_delay_ms="1") for _ in range(2)]
+    rng = np.random.RandomState(8)
+    pool = rng.rand(64, 64).astype(np.float32)
+    bc = BinaryClient("127.0.0.1", reps[0].binary_port)
+    try:
+        chunks = []
+        for i in range(0, 64, 8):      # stay under max_batch
+            status, out = bc.predict(pool[i:i + 8])
+            assert status == "ok", (status, out)
+            chunks.append(np.asarray(out))
+        refs = np.concatenate(chunks)
+    finally:
+        bc.close()
+    sink = MemorySink()
+    bal = _mk_balancer(reps, pairs=[("fleet_coalesce_ms", "5")],
+                       monitor=Monitor(sink))
+    fails, mismatches, oks = [], [], [0]
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(ci):
+        k = 0
+        while not stop.is_set():
+            i = (ci * 17 + k) % 64
+            k += 1
+            status, out, _ = bal.handle("", "t", pool[i:i + 1])
+            with lock:
+                if status != "ok":
+                    fails.append(status)
+                elif not np.allclose(np.asarray(out), refs[i:i + 1],
+                                     rtol=1e-5, atol=1e-6):
+                    mismatches.append(i)
+                else:
+                    oks[0] += 1
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(6)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.4)
+        reps[0].close(drain=False)       # the replica "dies" hard
+        time.sleep(0.8)                  # traffic must keep flowing
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        bal.close()
+        for r in reps[1:]:
+            r.close()
+    assert not any(t.is_alive() for t in threads)
+    assert fails == [], fails[:5]
+    assert mismatches == [], mismatches[:5]
+    assert oks[0] > 50
+    assert validate_records(sink.records, strict=False) == []

@@ -125,6 +125,24 @@ def test_classify_load_idle_and_steady():
     assert classify_load(dict(low, queue_rows=8), t)[0] == "steady"
 
 
+def test_take_window_carries_datapath_health(balancer_pair):
+    """The autoscaler's window gained the data-path signals
+    (channel_depth, forwards, coalesce_fill) — present, sane, and
+    transparent to classify_load."""
+    bal, reps, _, _ = balancer_pair
+    rows = np.zeros((1, 64), np.float32)
+    bal.take_window()                      # reset
+    for _ in range(3):
+        code, _ = _http_predict(bal.http_port, "gold", rows)
+        assert code == 200
+    w = bal.take_window()
+    assert w["requests"] == 3 and w["forwards"] == 3
+    assert w["coalesce_fill"] == 1.0       # coalescing off by default
+    assert w["channel_depth"] >= 0
+    t = _tier()
+    assert classify_load(w, t)[0] in ("idle", "steady")
+
+
 def test_canary_decision_matrix():
     t = _tier(canary_min_requests=20, canary_max_error_rate=0.05,
               canary_p99_ratio=2.0)

@@ -425,35 +425,42 @@ def _seal_bench_bundle(cfg, snapshot, monitor):
     return out
 
 
-def _drive_fleet(ctl, pool, clients, requests, request_rows,
-                 mid_traffic=None):
-    """Closed-loop binary clients against the balancer; returns
-    per-outcome counts. ``mid_traffic`` (optional callable) runs on
-    the driver thread once traffic is established — the kill
-    injector. Sheds (busy/over_quota) are back-off signals, not
-    failures; anything else non-ok is a failed request."""
+def _client_proc_main(port, pool, n_clients, requests, request_rows,
+                      base_ci, outq):
+    """One driver WORKER PROCESS: n_clients closed-loop threads
+    against the balancer. Living in its own process keeps the client
+    threads' GIL pressure out of the balancer process — in production
+    clients are not the balancer's threads, and measuring them there
+    charges their scheduling to the balancer's p99."""
     import threading
 
     from cxxnet_tpu.serve import BinaryClient
 
-    counts = {"ok": 0, "shed": 0, "failed": []}
+    counts = {"ok": 0, "shed": 0, "failed": [], "lat": []}
     lock = threading.Lock()
 
     def client(ci):
-        bc = BinaryClient("127.0.0.1", ctl.balancer.binary_port,
-                          timeout=120)
+        lats = []
+        try:
+            bc = BinaryClient("127.0.0.1", port, timeout=120)
+        except OSError as e:
+            with lock:
+                counts["failed"].append(repr(e))
+            return
         try:
             for r in range(requests):
                 start = (ci * requests + r) * request_rows % 256
                 rows = np.take(pool,
                                range(start, start + request_rows),
                                axis=0, mode="wrap")
+                t0 = time.time()
                 try:
                     status, _ = bc.predict(rows)
                 except Exception as e:
                     with lock:
                         counts["failed"].append(repr(e))
                     break
+                lats.append(time.time() - t0)
                 with lock:
                     if status == "ok":
                         counts["ok"] += 1
@@ -463,16 +470,57 @@ def _drive_fleet(ctl, pool, clients, requests, request_rows,
                         counts["failed"].append(status)
         finally:
             bc.close()
+            with lock:
+                counts["lat"].extend(lats)
 
-    threads = [threading.Thread(target=client, args=(i,))
-               for i in range(clients)]
-    t0 = time.time()
+    threads = [threading.Thread(target=client, args=(base_ci + i,))
+               for i in range(n_clients)]
     for t in threads:
         t.start()
-    if mid_traffic is not None:
-        mid_traffic()
     for t in threads:
         t.join()
+    outq.put(counts)
+
+
+def _drive_fleet(ctl, pool, clients, requests, request_rows,
+                 mid_traffic=None, procs=4):
+    """Closed-loop binary clients against the balancer, spread over
+    a few driver WORKER PROCESSES (the clients' own thread scheduling
+    must not ride the balancer process); returns per-outcome counts
+    including client-side latencies. ``mid_traffic`` (optional
+    callable) runs on the driver thread once traffic is established —
+    the kill injector. Sheds (busy/over_quota) are back-off signals,
+    not failures; anything else non-ok is a failed request."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("fork")
+    procs = max(1, min(procs, clients))
+    outq = ctx.Queue()
+    share = [clients // procs + (1 if i < clients % procs else 0)
+             for i in range(procs)]
+    workers = []
+    base = 0
+    t0 = time.time()
+    for i, n in enumerate(share):
+        if not n:
+            continue
+        p = ctx.Process(target=_client_proc_main,
+                        args=(ctl.balancer.binary_port, pool, n,
+                              requests, request_rows, base, outq))
+        p.start()
+        workers.append(p)
+        base += n
+    if mid_traffic is not None:
+        mid_traffic()
+    counts = {"ok": 0, "shed": 0, "failed": [], "lat": []}
+    for _ in workers:
+        c = outq.get(timeout=600)
+        counts["ok"] += c["ok"]
+        counts["shed"] += c["shed"]
+        counts["failed"].extend(c["failed"])
+        counts["lat"].extend(c["lat"])
+    for p in workers:
+        p.join(timeout=60)
     counts["wall_s"] = time.time() - t0
     return counts
 
@@ -489,7 +537,27 @@ def _fleet_point_stats(sink, counts, request_rows):
 
     retries = sum(r["retries"] for r in sink.records
                   if r["event"] == "fleet_route")
+    # coalesce fill: mean client requests per forwarded super-batch
+    # (fleet_batch records exist only when fleet_coalesce_ms > 0)
+    merged = [r for r in sink.records if r["event"] == "fleet_batch"]
+    fill = round(sum(r["requests"] for r in merged)
+                 / len(merged), 2) if merged else 1.0
+    # CLIENT-side latency: what a caller actually waits, including
+    # the socket/thread queueing BEFORE the balancer's handle() —
+    # fleet_route latency starts inside handle(), so a data path
+    # whose queueing happens in the coalescer (measured) would read
+    # unfairly worse than one whose queueing hides in the accept/
+    # scheduling path (unmeasured). The closed-loop sanity bound is
+    # Little's law: mean latency = clients / throughput.
+    clat = sorted(counts.get("lat", []))
+
+    def cpct(q):
+        return round(clat[min(len(clat) - 1,
+                              int(q * len(clat)))] * 1e3, 3) \
+            if clat else 0.0
+
     return {
+        "client_p50_ms": cpct(0.50), "client_p99_ms": cpct(0.99),
         "requests_ok": counts["ok"], "requests_shed": counts["shed"],
         "requests_failed": len(counts["failed"]),
         "rows_per_sec": round(
@@ -497,7 +565,39 @@ def _fleet_point_stats(sink, counts, request_rows):
         if counts["wall_s"] > 0 else 0.0,
         "latency_p50_ms": pct(0.50), "latency_p99_ms": pct(0.99),
         "retries_recovered": retries,
+        "coalesce_fill": fill,
+        "coalesced_forwards": len(merged),
         "wall_s": round(counts["wall_s"], 2),
+    }
+
+
+def _fleet_fill_stats(ctl):
+    """Replica-side batch economics summed over every live replica's
+    /healthz model rows (cumulative batcher counters): the pad
+    fraction the coalescer exists to shrink."""
+    batches = batch_rows = bucket_rows = pad_rows = cap = 0
+    for rep in ctl.manager.replicas():
+        if not rep.alive():
+            continue
+        try:
+            h = _get_json(rep.http_port, "/healthz")
+        except (OSError, ValueError):
+            continue
+        for m in h.get("model_health", []):
+            if "batch_rows" not in m:
+                return {}          # pre-upgrade replica build
+            batches += m["batches"]
+            batch_rows += m["batch_rows"]
+            bucket_rows += m["bucket_rows"]
+            pad_rows += m["pad_rows"]
+            cap += m["batches"] * m["max_batch"]
+    if not batches:
+        return {}
+    return {
+        "replica_batches": batches,
+        "fill_rate": round(batch_rows / float(max(1, cap)), 4),
+        "pad_fraction": round(pad_rows / float(max(1, bucket_rows)),
+                              4),
     }
 
 
@@ -515,6 +615,122 @@ def _fleet_compile_events(ctl):
         total += sum(m["compile_events"]
                      for m in h.get("model_health", []))
     return total
+
+
+def run_datapath_micro(ctl, pool, requests=250, clients=24):
+    """Isolate the balancer→replica data path (the tier PR 13
+    rebuilt): drive ONE live replica process through each forwarding
+    mode at the same offered load and count rows/s + per-wire-op
+    latency. The end-to-end sweep can hide this tier behind the
+    balancer process's own per-request CPU on a contended host; this
+    section measures the forwarding contract itself.
+
+    - ``v1_blocking`` — the r12 path: one blocking round trip per
+      in-flight request over pooled connections (a thread per
+      request).
+    - ``v2_pipelined`` — the same offered concurrency multiplexed
+      over two ReplicaChannels (correlated frames, out-of-order
+      replies).
+    - ``v2_coalesced`` — the same rows as merged super-batches (the
+      balancer coalescer's forward shape, 12 rows per frame).
+    """
+    import threading
+
+    from cxxnet_tpu.fleet import ReplicaChannel
+    from cxxnet_tpu.serve import BinaryClient
+
+    rep = ctl.manager.replicas()[0]
+    rows1 = np.ascontiguousarray(pool[:1], dtype="<f4")
+
+    def stats(lats, nrows, wall):
+        lats.sort()
+
+        def pct(q):
+            return round(lats[min(len(lats) - 1,
+                                  int(q * len(lats)))] * 1e3, 3) \
+                if lats else 0.0
+
+        return {"rows_per_sec": round(nrows / wall, 2),
+                "wire_p50_ms": pct(0.50), "wire_p99_ms": pct(0.99)}
+
+    def drive(fn, nthreads):
+        lats = []
+        lock = threading.Lock()
+
+        def worker(ci):
+            mine = []
+            fn(ci, mine)
+            with lock:
+                lats.extend(mine)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(nthreads)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return lats, time.time() - t0
+
+    out = {}
+    # v1: blocking round trips, one connection per concurrent request
+    def v1_client(ci, mine):
+        bc = BinaryClient("127.0.0.1", rep.binary_port, timeout=120)
+        try:
+            for _ in range(requests):
+                t0 = time.time()
+                status, _ = bc.predict(rows1)
+                assert status == "ok", status
+                mine.append(time.time() - t0)
+        finally:
+            bc.close()
+
+    lats, wall = drive(v1_client, clients)
+    out["v1_blocking"] = dict(stats(lats, clients * requests, wall),
+                              connections=clients, merge=1)
+
+    # v2: the same offered concurrency pipelined over two channels
+    chans = [ReplicaChannel("127.0.0.1", rep.binary_port, index=i)
+             for i in range(2)]
+
+    def v2_client(ci, mine):
+        buf = [memoryview(rows1).cast("B")]
+        for r in range(requests):
+            ch = chans[(ci + r) % len(chans)]
+            t0 = time.time()
+            fut = ch.submit("", "", buf, 1, rows1.size, 0.0, 120.0)
+            status, _ = fut.result(120)
+            assert status == "ok", status
+            mine.append(time.time() - t0)
+
+    lats, wall = drive(v2_client, clients)
+    out["v2_pipelined"] = dict(stats(lats, clients * requests, wall),
+                               connections=len(chans), merge=1)
+
+    # v2 coalesced: the same rows as 12-row super-batches
+    merge = 12
+    groups = max(1, clients // merge)
+    big = np.ascontiguousarray(
+        np.repeat(rows1, merge, axis=0), dtype="<f4")
+
+    def v2_merged(ci, mine):
+        buf = [memoryview(big).cast("B")]
+        for r in range(requests):
+            ch = chans[(ci + r) % len(chans)]
+            t0 = time.time()
+            fut = ch.submit("", "", buf, merge, rows1.size, 0.0,
+                            120.0)
+            status, _ = fut.result(120)
+            assert status == "ok", status
+            mine.append(time.time() - t0)
+
+    lats, wall = drive(v2_merged, groups)
+    out["v2_coalesced"] = dict(
+        stats(lats, groups * requests * merge, wall),
+        connections=len(chans), merge=merge)
+    for ch in chans:
+        ch.close()
+    return out
 
 
 def run_multi_replica(args, monitor, sink):
@@ -543,6 +759,8 @@ def run_multi_replica(args, monitor, sink):
               "buckets": args.buckets,
               "max_delay_ms": args.max_delay_ms,
               "dtype": args.serve_dtype or "float32",
+              "coalesce_ms": args.coalesce_ms,
+              "channels_per_replica": args.channels,
               "slo_p99_ms": args.slo_p99_ms}
     failures, recompiles = 0, 0
     # the CLI serve knobs must reach the REPLICA processes (which read
@@ -592,21 +810,33 @@ def run_multi_replica(args, monitor, sink):
             ("fleet_dir", os.path.join(td, "run")),
         ]
 
-        def boot(n, extra=()):
+        # the data path under test (channels + coalescing); the
+        # baseline sweep pins the r12 path (pooled, no coalescing)
+        datapath = [
+            ("fleet_channels_per_replica", str(args.channels)),
+            ("fleet_coalesce_ms", "%g" % args.coalesce_ms),
+        ]
+        baseline_path = [
+            ("fleet_channels_per_replica", "0"),
+            ("fleet_coalesce_ms", "0"),
+        ]
+
+        def boot(n, extra=(), path=None):
             ctl = FleetController(
                 cfg + tier_base + [("fleet_replicas", str(n)),
                                    ("fleet_min_replicas", str(n))]
+                + (datapath if path is None else list(path))
                 + list(extra),
                 conf_path=conf_path, monitor=monitor,
                 extra_overrides=serve_overrides)
             ctl.start()
             return ctl
 
-        sweep = []
-        for n in sizes:
+        def one_point(n, path=None):
+            nonlocal pool, recompiles
             sink.clear()
             t0 = time.time()
-            ctl = boot(n)
+            ctl = boot(n, path=path)
             boot_s = time.time() - t0
             if pool is None:
                 inst = tuple(_get_json(
@@ -614,26 +844,62 @@ def run_multi_replica(args, monitor, sink):
                     "/v1/models")["models"][0]["instance_shape"])
                 pool = rng.uniform(0, 1, size=(256,) + inst) \
                     .astype(np.float32)
-            counts = _drive_fleet(ctl, pool, clients=4 * n,
+            cpr = args.fleet_clients_per_replica
+            counts = _drive_fleet(ctl, pool, clients=cpr * n,
                                   requests=args.requests,
                                   request_rows=args.request_rows)
             recompiles += _fleet_compile_events(ctl)
+            fill = _fleet_fill_stats(ctl)
             ctl.close()
             errs = validate_records(sink.records)
             assert not errs, "schema-invalid fleet telemetry: %s" \
                 % errs[:5]
-            pt = dict(_fleet_point_stats(sink, counts,
-                                         args.request_rows),
-                      replicas=n, clients=4 * n,
-                      boot_s=round(boot_s, 2))
+            return dict(_fleet_point_stats(sink, counts,
+                                           args.request_rows),
+                        replicas=n, clients=cpr * n,
+                        boot_s=round(boot_s, 2), **fill)
+
+        sweep = []
+        for n in sizes:
+            pt = one_point(n)
             failures += pt["requests_failed"]
             sweep.append(pt)
-            print("# replicas=%d: %.1f rows/s, p50 %.2f ms, p99 "
-                  "%.2f ms, %d ok / %d failed"
-                  % (n, pt["rows_per_sec"], pt["latency_p50_ms"],
-                     pt["latency_p99_ms"], pt["requests_ok"],
-                     pt["requests_failed"]), file=sys.stderr)
+            print("# replicas=%d: %.1f rows/s, client p50 %.2f ms "
+                  "p99 %.2f ms, %d ok / %d failed, coalesce fill "
+                  "%.2f, pad %.3f"
+                  % (n, pt["rows_per_sec"], pt["client_p50_ms"],
+                     pt["client_p99_ms"], pt["requests_ok"],
+                     pt["requests_failed"], pt["coalesce_fill"],
+                     pt.get("pad_fraction", -1)), file=sys.stderr)
         record["sweep"] = sweep
+
+        if args.fleet_baseline:
+            # before/after on the same model and drive: the r12 data
+            # path (pooled connections, no coalescing) per fleet size
+            base = []
+            for n in sizes:
+                pt = one_point(n, path=baseline_path)
+                failures += pt["requests_failed"]
+                base.append(pt)
+                print("# baseline replicas=%d: %.1f rows/s, client "
+                      "p50 %.2f ms p99 %.2f ms, pad %.3f"
+                      % (n, pt["rows_per_sec"], pt["client_p50_ms"],
+                         pt["client_p99_ms"],
+                         pt.get("pad_fraction", -1)),
+                      file=sys.stderr)
+            record["sweep_baseline"] = base
+
+        # -- data-path micro: the balancer→replica tier isolated -----
+        ctl = boot(1)
+        record["datapath_micro"] = run_datapath_micro(
+            ctl, pool, requests=min(args.requests, 250))
+        ctl.close()
+        for mode, m in record["datapath_micro"].items():
+            print("# datapath %-13s %8.1f rows/s, wire p50 %.2f ms "
+                  "p99 %.2f ms (merge=%d over %d conns)"
+                  % (mode, m["rows_per_sec"], m["wire_p50_ms"],
+                     m["wire_p99_ms"], m["merge"], m["connections"]),
+                  file=sys.stderr)
 
         # -- kill-a-replica mid-traffic (at the largest fleet) -------
         sink.clear()
@@ -647,10 +913,10 @@ def run_multi_replica(args, monitor, sink):
             print("# killed replica %s (pid %d) mid-traffic"
                   % (victim.replica_id, victim.pid), file=sys.stderr)
 
-        counts = _drive_fleet(ctl, pool, clients=4 * n,
-                              requests=args.requests,
-                              request_rows=args.request_rows,
-                              mid_traffic=killer)
+        counts = _drive_fleet(
+            ctl, pool, clients=args.fleet_clients_per_replica * n,
+            requests=args.requests, request_rows=args.request_rows,
+            mid_traffic=killer)
         healed = sum(1 for r in ctl.manager.replicas()
                      if r.alive()) >= n
         recompiles += _fleet_compile_events(ctl)
@@ -794,6 +1060,26 @@ def main(argv=None) -> int:
                          "behind the balancer, plus a kill-a-replica-"
                          "mid-traffic assertion (zero failed "
                          "requests) at the largest N")
+    ap.add_argument("--fleet-clients-per-replica", type=int,
+                    default=4,
+                    help="with --replicas: closed-loop clients per "
+                         "replica at each sweep point (default 4, "
+                         "the r12 drive; raise it for the "
+                         "high-concurrency small-request regime "
+                         "coalescing targets)")
+    ap.add_argument("--coalesce-ms", type=float, default=0.0,
+                    help="with --replicas: balancer-side coalesce "
+                         "window (fleet_coalesce_ms) — same-model "
+                         "requests arriving within it forward as one "
+                         "super-batch; 0 = off")
+    ap.add_argument("--channels", type=int, default=2,
+                    help="with --replicas: multiplexed v2 channels "
+                         "per replica (fleet_channels_per_replica); "
+                         "0 = the pooled v1 data path")
+    ap.add_argument("--fleet-baseline", action="store_true",
+                    help="with --replicas: also sweep the legacy "
+                         "data path (pooled connections, no "
+                         "coalescing) for a before/after record")
     ap.add_argument("--autoscale-soak", type=float, default=0.0,
                     help="with --replicas: also run an autoscale "
                          "soak capped at this many seconds per "
@@ -844,6 +1130,9 @@ def main(argv=None) -> int:
                  "run them as two invocations")
     if args.autoscale_soak and not args.replicas:
         ap.error("--autoscale-soak needs --replicas")
+    if (args.coalesce_ms or args.fleet_baseline) \
+            and not args.replicas:
+        ap.error("--coalesce-ms/--fleet-baseline need --replicas")
 
     from cxxnet_tpu.monitor import MemorySink, Monitor
     import jax
