@@ -83,7 +83,7 @@ def load_compare_record(path):
     else:
         old = {"alexnet": {k: prev[k]
                            for k in ("value", "spread", "suspect",
-                                     "dtype")
+                                     "dtype", "topology")
                            if k in prev}}
     for m, v in old.items():
         ov = v.get("value") if isinstance(v, dict) else v
@@ -132,6 +132,37 @@ def compare_models(old, new, floor=1.2):
                   # bf16 long before it was tagged)
                   "old_dtype": odt or "unknown",
                   "new_dtype": ndt or "unknown"}
+    return out
+
+
+def expected_topology(batch):
+    """The topology this process WILL measure a model at, computed
+    before the sweep: the trainer's default mesh rule (largest data
+    axis dividing the batch) over the current device set. Recorded
+    per model entry and compared against prior records up front."""
+    import jax
+    from cxxnet_tpu.parallel import default_data_axis
+    ndev = len(jax.devices())
+    return {"mesh": {"data": default_data_axis(batch, ndev),
+                     "model": 1},
+            "process_count": jax.process_count(),
+            "device_count": ndev}
+
+
+def topology_mismatches(old):
+    """Models whose prior record carries a topology (mesh shape /
+    process count / device count) DIFFERENT from what this sweep will
+    measure — img/s across topologies is not a regression signal, so
+    cross-topology diffs are refused (exit 2, like the dtype guard)
+    unless --allow-topology-mismatch. Untagged old records (pre-
+    topology rounds) compare freely."""
+    out = []
+    for m, v in sorted(old.items()):
+        ot = v.get("topology") if isinstance(v, dict) else None
+        if ot and m in MODELS:
+            exp = expected_topology(MODELS[m][1])
+            if ot != exp:
+                out.append((m, ot, exp))
     return out
 
 
@@ -266,6 +297,14 @@ def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
         # measured in different compute dtypes (img/s across dtypes is
         # not a regression signal)
         "dtype": dtype,
+        # topology-tagged capture: mesh shape + process/device counts
+        # this number was measured at; --compare refuses cross-
+        # topology diffs the same way (a 2x-device sweep is not a
+        # regression signal either)
+        "topology": {"mesh": {str(k): int(v)
+                              for k, v in dict(t.mesh.shape).items()},
+                     "process_count": jax.process_count(),
+                     "device_count": len(jax.devices())},
     }
     if peak_tflops > 0 and flops_img > 0:
         out["mfu"] = round(ips * flops_img / (peak_tflops * 1e12), 4)
@@ -453,6 +492,28 @@ def main():
                     help="compare img/s across records measured in "
                          "different compute dtypes anyway (the rows "
                          "stay dtype-annotated)")
+    ap.add_argument("--allow-topology-mismatch", action="store_true",
+                    help="compare img/s across records measured at "
+                         "different mesh/process topologies anyway "
+                         "(the rows stay topology-annotated)")
+    ap.add_argument("--hosts", metavar="H1,H2,..", default=None,
+                    help="multi-host dryrun scaling sweep: fake each "
+                         "world size over this process's devices and "
+                         "measure the sharded input path (img/s, "
+                         "per-host data-wait, exactly-once row "
+                         "accounting) — the MULTICHIP_r*.json capture "
+                         "path; on-chip collective time stays pending "
+                         "a device window (doc/distributed.md)")
+    ap.add_argument("--virtual-devices", type=int, default=0,
+                    help="force N virtual CPU devices before the "
+                         "backend initializes (the --hosts dryrun "
+                         "needs a world size that divides the device "
+                         "count; 0 = leave the backend alone)")
+    ap.add_argument("--hosts-rows", type=int, default=2048,
+                    help="dataset rows for the --hosts sweep")
+    ap.add_argument("--hosts-batch", type=int, default=64,
+                    help="global batch for the --hosts sweep (every "
+                         "host count must divide it)")
     ap.add_argument("--peak-tflops", type=float, default=0.0,
                     help="chip peak TFLOP/s for the compute dtype; "
                          "when set, each model's record carries "
@@ -472,13 +533,36 @@ def main():
                          "record, argparse's)")
     args = ap.parse_args()
     if args.compare and (args.model or args.pipeline or
-                         args.pipeline_raw):
+                         args.pipeline_raw or args.hosts):
         ap.error("--compare runs the all-model sweep; drop --model/"
-                 "--pipeline")
+                 "--pipeline/--hosts")
     for kv in args.extra:
         if "=" not in kv:
             ap.error("--extra expects K=V, got %r" % kv)
     extra_cfg = tuple(kv.split("=", 1) for kv in args.extra)
+    if args.virtual_devices > 0:
+        from cxxnet_tpu.parallel import force_virtual_cpu
+        force_virtual_cpu(args.virtual_devices)
+    if args.hosts:
+        try:
+            hosts = [int(t) for t in args.hosts.split(",") if t]
+        except ValueError:
+            ap.error("--hosts expects a comma list of ints, got %r"
+                     % args.hosts)
+        from cxxnet_tpu.monitor import MemorySink, Monitor
+        from cxxnet_tpu.monitor.schema import validate_records
+        from cxxnet_tpu.parallel.scaling import dryrun_scaling_sweep
+        sink = MemorySink()
+        rec = dryrun_scaling_sweep(
+            hosts, rows=args.hosts_rows,
+            global_batch=args.hosts_batch, monitor=Monitor(sink))
+        validate_records(sink.records)
+        print(json.dumps(rec))
+        if not (rec["loss_parity"] and rec["exactly_once"]
+                and all(p["zero_recompiles"] for p in rec["points"])):
+            # an invariant breach is a failed capture, not a record
+            raise SystemExit(1)
+        return
     if args.pipeline or args.pipeline_raw:
         cap = measure_pipeline(raw=args.pipeline_raw)
         print(json.dumps({
@@ -548,6 +632,16 @@ def main():
                 "%s); pass --allow-dtype-mismatch to diff anyway"
                 % (", ".join("%s is %s" % mv for mv in mism),
                    args.dtype))
+        # same rule for topology: a record measured at a different
+        # mesh shape / process count / device count is not a
+        # regression signal at this one (exit 2, before the sweep)
+        tmism = topology_mismatches(old)
+        if tmism and not args.allow_topology_mismatch:
+            ap.error(
+                "cannot compare across topologies: %s; pass "
+                "--allow-topology-mismatch to diff anyway"
+                % ", ".join("%s was %r, this sweep is %r" % mt
+                            for mt in tmism))
     import gc
     models = {}
     for m in sorted(MODELS):

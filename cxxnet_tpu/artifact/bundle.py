@@ -153,6 +153,16 @@ def runtime_fingerprint(mesh=None) -> Dict[str, Any]:
     import jax
     import jaxlib
     devs = jax.devices()
+    # process_count + device_count + the mesh entry below ARE the
+    # physical host-topology seal (local devices per host is exactly
+    # device_count / process_count): a different world size or mesh
+    # shape fails the dict-equality gate and every program rebuilds
+    # with one warning. The dryrun's FAKED host count is deliberately
+    # absent — the SPMD programs are identical at any faked input
+    # partition, so an elastic dryrun resize keeps its zero-compile
+    # bundle boot (doc/distributed.md) — and no redundant key means
+    # bundles sealed before this convention was written down stay
+    # valid
     fp = {
         "platform": jax.default_backend(),
         "jax": jax.__version__,

@@ -24,10 +24,24 @@ class CSVIterator(IIterator):
         self.shape = (0, 0, 0)
         self.part_index = 0
         self.num_parts = 1
+        # shard_kind = stride keeps the legacy rank-strided split;
+        # batch applies the deterministic batch-block map
+        # (io/shard.py) whose rank-order concatenation reconstructs
+        # the exact single-host batch — the multi-host assembly /
+        # dryrun mode (doc/distributed.md)
+        self.shard_kind = "stride"
+        self.shard_global_batch = 0
+        self.shard_start_record = 0
         self.rows: Optional[np.ndarray] = None
         self.indices: Optional[np.ndarray] = None
         self.idx = 0
         self.out: Optional[DataInst] = None
+        # batch-kind shard state: full row set + the steady (no-
+        # handoff-offset) index view before_first switches to after
+        # the resumed pass completes
+        self._all_rows: Optional[np.ndarray] = None
+        self._steady_idx: Optional[np.ndarray] = None
+        self._pass_ended = False
 
     def set_param(self, name: str, val: str) -> None:
         if name == "filename":
@@ -44,6 +58,15 @@ class CSVIterator(IIterator):
             self.part_index = int(val)
         if name == "num_parts":
             self.num_parts = int(val)
+        if name == "shard_kind":
+            if val not in ("stride", "batch"):
+                raise ValueError(
+                    "shard_kind must be stride or batch, got %r" % val)
+            self.shard_kind = val
+        if name == "shard_global_batch":
+            self.shard_global_batch = int(val)
+        if name == "shard_start_record":
+            self.shard_start_record = int(val)
 
     def init(self) -> None:
         skip = 1 if self.has_header else 0
@@ -55,19 +78,53 @@ class CSVIterator(IIterator):
             raise ValueError(
                 "CSVIterator: row width %d != label_width %d + features %d"
                 % (self.rows.shape[1], self.label_width, nfeat))
-        # disjoint strided shard per distributed rank
-        pi, nparts = resolve_data_shard(self.part_index, self.num_parts)
-        self.indices = np.arange(self.rows.shape[0])[pi::nparts]
-        self.rows = self.rows[pi::nparts]
+        if self.shard_kind == "batch":
+            # deterministic batch-block shard (io/shard.py): this
+            # host's contiguous slice of every global batch, so the
+            # fleet's rank-order assembly is bit-identical to the
+            # unsharded read. The shard_start_record handoff offset
+            # applies to the FIRST pass only (the resumed epoch);
+            # before_first switches to the steady plan after a
+            # completed pass so later epochs read the full dataset
+            from .shard import plan_from_params
+            assert self.shard_global_batch > 0, \
+                "shard_kind=batch requires shard_global_batch"
+            plan = plan_from_params(self.part_index, self.num_parts,
+                                    self.shard_global_batch,
+                                    self.shard_start_record)
+            self._all_rows = self.rows
+            n = self._all_rows.shape[0]
+            self._steady_idx = np.asarray(
+                plan.steady().owned_indices(n), np.int64)
+            self.indices = np.asarray(plan.owned_indices(n), np.int64) \
+                if plan.start_record else self._steady_idx
+            self.rows = self._all_rows[self.indices]
+        else:
+            # disjoint strided shard per distributed rank
+            pi, nparts = resolve_data_shard(self.part_index,
+                                            self.num_parts)
+            self.indices = np.arange(self.rows.shape[0])[pi::nparts]
+            self.rows = self.rows[pi::nparts]
         if self.silent == 0:
             print("CSVIterator:filename=%s" % self.filename)
         self.idx = 0
 
     def before_first(self) -> None:
+        # a reset after any consumption ends the resumed pass: the
+        # handoff offset has done its job and later epochs read the
+        # full shard (ShardPlan.steady). Resets before consumption
+        # (adapter init + the first epoch start) keep the offset.
+        if (self._all_rows is not None
+                and (self._pass_ended or self.idx > 0)
+                and self.indices is not self._steady_idx):
+            self.indices = self._steady_idx
+            self.rows = self._all_rows[self.indices]
         self.idx = 0
+        self._pass_ended = False
 
     def next(self) -> bool:
         if self.rows is None or self.idx >= self.rows.shape[0]:
+            self._pass_ended = True
             return False
         row = self.rows[self.idx]
         label = row[:self.label_width]

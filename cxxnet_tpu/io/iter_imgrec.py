@@ -39,6 +39,18 @@ class ImageRecordIterator(IIterator):
         self.silent = 0
         self.dist_num_parts = 1
         self.dist_part_index = 0
+        # shard_kind = stride keeps the byte-range split (InputSplit
+        # parity); batch applies the deterministic batch-block record
+        # map (io/shard.py): the reader scans every record header but
+        # DECODES only its own slice, so the expensive per-host work
+        # stays 1/H as hosts grow while the fleet's rank-order
+        # assembly reconstructs the exact single-host batch
+        self.shard_kind = "stride"
+        self.shard_global_batch = 0
+        self.shard_start_record = 0
+        self._shard_plan = None
+        self._rec_seq = 0
+        self._pass_ended = False
         self.nthread = max(4, os.cpu_count() or 4)
         self.shuffle = 0
         self.seed = 0
@@ -63,6 +75,15 @@ class ImageRecordIterator(IIterator):
             self.dist_num_parts = int(val)
         if name == "part_index":
             self.dist_part_index = int(val)
+        if name == "shard_kind":
+            if val not in ("stride", "batch"):
+                raise ValueError(
+                    "shard_kind must be stride or batch, got %r" % val)
+            self.shard_kind = val
+        if name == "shard_global_batch":
+            self.shard_global_batch = int(val)
+        if name == "shard_start_record":
+            self.shard_start_record = int(val)
         if name == "nthread":
             self.nthread = int(val)
         if name == "shuffle":
@@ -101,7 +122,20 @@ class ImageRecordIterator(IIterator):
         self._autodetect_rank()
         paths = [p for p in self.path_imgrec.split(",") if p]
         self._readers = []
-        if len(paths) == 1:
+        if self.shard_kind == "batch":
+            # batch-block sharding (io/shard.py): every reader scans
+            # the FULL archive stream in record order and _fill skips
+            # decode for records other hosts own — exact record-index
+            # ownership, which byte-range splits cannot express
+            from .shard import plan_from_params
+            assert self.shard_global_batch > 0, \
+                "shard_kind=batch requires shard_global_batch"
+            self._shard_plan = plan_from_params(
+                self.dist_part_index, self.dist_num_parts,
+                self.shard_global_batch, self.shard_start_record)
+            for p in paths:
+                self._readers.append(RecordIOReader(p, 0, 1))
+        elif len(paths) == 1:
             self._readers.append(RecordIOReader(
                 paths[0], self.dist_part_index, self.dist_num_parts))
         else:
@@ -152,9 +186,18 @@ class ImageRecordIterator(IIterator):
         self.before_first()
 
     def before_first(self) -> None:
+        # a reset after any consumption ends the resumed pass: the
+        # shard_start_record handoff offset applies to the FIRST pass
+        # only — later epochs read the full shard (ShardPlan.steady);
+        # resets before consumption (init / epoch start) keep it
+        if self._shard_plan is not None \
+                and (self._pass_ended or self._rec_seq > 0):
+            self._shard_plan = self._shard_plan.steady()
+        self._pass_ended = False
         for r in self._readers:
             r.reset()
         self._cur_reader = 0
+        self._rec_seq = 0
         self._buf, self._bufpos = [], 0
 
     # -- decode ----------------------------------------------------------
@@ -203,6 +246,11 @@ class ImageRecordIterator(IIterator):
             if r is None:
                 self._cur_reader += 1
                 continue
+            if self._shard_plan is not None:
+                owned = self._shard_plan.owns(self._rec_seq)
+                self._rec_seq += 1
+                if not owned:
+                    continue             # another host's record: no decode
             recs.append(r)
         if not recs:
             return False
@@ -218,6 +266,7 @@ class ImageRecordIterator(IIterator):
     def next(self) -> bool:
         while self._bufpos >= len(self._buf):
             if not self._fill():
+                self._pass_ended = True
                 return False
         self._out = self._buf[self._bufpos]
         self._bufpos += 1

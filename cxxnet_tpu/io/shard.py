@@ -1,0 +1,138 @@
+"""Deterministic per-host record sharding for multi-host input.
+
+The reference shards its RecordIO archives across distributed workers
+by byte range (InputSplit rank/size, iter_image_recordio-inl.hpp:
+183-185) — good enough when workers only ever see their own stream,
+but it gives no guarantee about the GLOBAL batch a fleet assembles.
+This module defines the shard map the multi-host path (and its
+single-process dryrun) uses instead — the **batch-block** map:
+
+    global batch k holds records [k*B, (k+1)*B)
+    host h of H owns rows [h*b, (h+1)*b) of every global batch
+    (b = B/H), i.e. records  k*B + h*b .. k*B + (h+1)*b - 1
+
+Three properties fall out, each load-bearing:
+
+- **exactly-once**: every record index is owned by exactly one host —
+  no duplicated and no dropped data fleet-wide, at any world size
+  (pinned by tests/test_shard_property.py).
+- **bit-identical assembly**: concatenating the hosts' slices in rank
+  order reconstructs the exact single-host record order, so the
+  global batch formed from per-host local arrays (via
+  ``jax.make_array_from_process_local_data``, or the dryrun's
+  concatenation) is byte-for-byte the batch an unsharded reader would
+  have produced — the dryrun's loss-parity invariant.
+- **elastic re-derivation**: :meth:`ShardPlan.rederive` re-bases the
+  map at a batch boundary for a NEW world size. Records before the
+  handoff point were consumed exactly once by the old plan; records
+  after it are owned exactly once by the new plans — the no-dup /
+  no-loss data-order handoff a preemption resize needs
+  (doc/distributed.md "Elasticity").
+
+Iterators consume this through three params (doc/io.md):
+``shard_kind = batch`` (default ``stride`` keeps the legacy
+rank-strided split), ``shard_global_batch`` (B — the records each
+global batch consumes), ``shard_start_record`` (the handoff offset, 0
+for a fresh epoch).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+def shard_owner(index: int, global_batch: int, num_hosts: int,
+                start_record: int = 0) -> int:
+    """Host rank owning record ``index``, or -1 for records before the
+    handoff point (already consumed under the previous plan)."""
+    if index < start_record:
+        return -1
+    local = global_batch // num_hosts
+    return ((index - start_record) % global_batch) // local
+
+
+class ShardPlan:
+    """One host's view of the batch-block shard map."""
+
+    __slots__ = ("host_rank", "num_hosts", "global_batch",
+                 "start_record", "local_rows")
+
+    def __init__(self, host_rank: int, num_hosts: int,
+                 global_batch: int, start_record: int = 0):
+        host_rank, num_hosts = int(host_rank), int(num_hosts)
+        global_batch, start_record = int(global_batch), int(start_record)
+        if num_hosts < 1 or not (0 <= host_rank < num_hosts):
+            raise ValueError("bad shard rank %d/%d"
+                             % (host_rank, num_hosts))
+        if global_batch < 1 or global_batch % num_hosts != 0:
+            raise ValueError(
+                "shard_global_batch=%d must divide evenly across %d "
+                "hosts (every host contributes an equal slice of "
+                "every global batch)" % (global_batch, num_hosts))
+        if start_record < 0 or start_record % global_batch != 0:
+            raise ValueError(
+                "shard_start_record=%d must sit on a global-batch "
+                "boundary (multiple of %d): the elastic handoff point "
+                "is an update boundary" % (start_record, global_batch))
+        self.host_rank = host_rank
+        self.num_hosts = num_hosts
+        self.global_batch = global_batch
+        self.start_record = start_record
+        self.local_rows = global_batch // num_hosts
+
+    def owns(self, index: int) -> bool:
+        return shard_owner(index, self.global_batch, self.num_hosts,
+                           self.start_record) == self.host_rank
+
+    def owned_indices(self, n_records: int) -> List[int]:
+        """Every record index in [0, n_records) this host owns — the
+        accounting form the property test and the CSV reader use."""
+        return [i for i in range(int(n_records)) if self.owns(i)]
+
+    def slice_of_batch(self, k: int):
+        """(lo, hi) record range this host owns of global batch k
+        (k counted from the handoff point)."""
+        base = self.start_record + int(k) * self.global_batch
+        lo = base + self.host_rank * self.local_rows
+        return lo, lo + self.local_rows
+
+    def steady(self) -> "ShardPlan":
+        """The same shard map with the handoff offset cleared — the
+        plan every pass AFTER the resumed one uses. ``start_record``
+        exists to skip records the interrupted epoch already consumed;
+        applying it to later epochs would silently drop the dataset's
+        head forever (the readers switch to this automatically at
+        their next reset after a completed pass)."""
+        if not self.start_record:
+            return self
+        return ShardPlan(self.host_rank, self.num_hosts,
+                         self.global_batch, 0)
+
+    def rederive(self, host_rank: int, num_hosts: int,
+                 batches_consumed: int) -> "ShardPlan":
+        """The elastic handoff: a new plan for the resized fleet,
+        re-based at the update boundary ``batches_consumed`` global
+        batches past this plan's start. The global batch size is a
+        config constant (doc/global.md: batch_size is GLOBAL), so
+        only the per-host slice width changes with the world size."""
+        return ShardPlan(
+            host_rank, num_hosts, self.global_batch,
+            self.start_record
+            + int(batches_consumed) * self.global_batch)
+
+    def describe(self) -> Dict[str, int]:
+        return {"host_rank": self.host_rank,
+                "num_hosts": self.num_hosts,
+                "global_batch": self.global_batch,
+                "start_record": self.start_record}
+
+
+def plan_from_params(part_index: int, num_parts: int,
+                     global_batch: int,
+                     start_record: int = 0) -> ShardPlan:
+    """Build the plan from iterator params, resolving the rank the
+    same way the strided path does (explicit config wins, else the
+    distributed process rank autodetects — data.resolve_data_shard)."""
+    from .data import resolve_data_shard
+    pi, np_ = resolve_data_shard(part_index, num_parts)
+    return ShardPlan(pi, np_, global_batch, start_record)

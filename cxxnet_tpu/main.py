@@ -29,8 +29,11 @@ from .monitor import (Monitor, create_monitor, device_memory_snapshot,
                       run_metadata, set_global)
 from .nnet.checkpoint import CheckpointManager, find_latest_valid
 from .nnet.trainer import NetTrainer
-from .parallel import (allreduce_host_sum, init_distributed, is_root,
+from .parallel import (allreduce_host_sum, clear_dryrun_topology,
+                       current_topology, init_distributed, is_root,
+                       set_allreduce_retry, set_dryrun_topology,
                        synced_batches, world_size)
+from .parallel.topology import DryrunFeed, build_dryrun_feed
 from .utils.config import (parse_cli_overrides, parse_config_file,
                            split_sections)
 from .utils.stream import open_stream, set_stream_retry, uri_scheme
@@ -115,6 +118,20 @@ class LearnTask:
         # output bundle directory; "" derives NNNN.model.bundle beside
         # model_in so a watched model_dir picks the bundle up
         self.export_out = ""
+        # multi-host SPMD launch (doc/distributed.md): coordinator
+        # address + world shape driving jax.distributed.initialize.
+        # Env vars (CXXNET_COORDINATOR et al.) and managed-runtime
+        # autodetect keep working; config keys win when set.
+        self.dist_coordinator = ""
+        self.dist_num_hosts = 0          # 0 = env / runtime autodetect
+        self.dist_host_rank = -1         # -1 = env / runtime autodetect
+        # single-process multi-host dryrun: fake N input hosts over
+        # this process's devices — full shard math (mesh build,
+        # per-host batch assembly, re-derivation), zero DCN
+        self.dist_dryrun_hosts = 0
+        # bounded retries for the process-group metric allreduce
+        # (transient DCN hiccups re-enter the collective; 0 fails fast)
+        self.dist_allreduce_retry = 2
         # observability (doc/observability.md); a null monitor until
         # run() builds the configured one, so task methods are safe to
         # call directly in tests
@@ -195,6 +212,16 @@ class LearnTask:
             self.quantize_out = val
         if name == "export_out":
             self.export_out = val
+        if name == "dist_coordinator":
+            self.dist_coordinator = val
+        if name == "dist_num_hosts":
+            self.dist_num_hosts = int(val)
+        if name == "dist_host_rank":
+            self.dist_host_rank = int(val)
+        if name == "dist_dryrun_hosts":
+            self.dist_dryrun_hosts = int(val)
+        if name == "dist_allreduce_retry":
+            self.dist_allreduce_retry = int(val)
 
     # -- model files -----------------------------------------------------
 
@@ -240,12 +267,20 @@ class LearnTask:
         if ndev:
             from .parallel import force_virtual_cpu
             force_virtual_cpu(int(ndev))
-        init_distributed()
+        # config parses BEFORE distributed bring-up (pure text, no jax
+        # touched) so the dist_* launch keys can drive
+        # jax.distributed.initialize — env vars stay as fallback
         cfg = parse_config_file(argv[0])
         cfg += parse_cli_overrides(argv[1:])
         blocks, global_cfg = split_sections(cfg)
         for name, val in global_cfg:
             self._set(name, val)
+        init_distributed(
+            coordinator=self.dist_coordinator or None,
+            num_processes=self.dist_num_hosts or None,
+            process_id=None if self.dist_host_rank < 0
+            else self.dist_host_rank)
+        set_allreduce_retry(self.dist_allreduce_retry)
         # 'pred = <outfile>' doubles as the pred-block marker
         # (cxxnet_main.cpp:281-282), so read it from the raw stream
         for name, val in cfg:
@@ -267,6 +302,10 @@ class LearnTask:
         # hoisted above the try so the finally can always iterate it
         all_iters: List[object] = []
         try:
+            if self.dist_dryrun_hosts > 1:
+                # fake the input topology for THIS run; cleared in the
+                # finally so library callers never inherit a stale fake
+                set_dryrun_topology(self.dist_dryrun_hosts)
             # model_in via filename convention infers start counter when
             # continuing training (cxxnet_main.cpp:204-215); finetune starts
             # a fresh model numbering
@@ -352,6 +391,33 @@ class LearnTask:
                         "shuffle/augmentation disabled" %
                         (self.task, b["name"]))
             for b in blocks:
+                if (self.dist_dryrun_hosts > 1 and b["kind"] == "data"
+                        and (self.test_io
+                             or self.task in ("train", "finetune"))):
+                    # multi-host dryrun (doc/distributed.md): one
+                    # batch-block-sharded chain per virtual host,
+                    # assembled into the exact single-host global
+                    # batch in host-rank order. Eval blocks stay
+                    # unsharded — the shard math under test is the
+                    # training input path
+                    gbs = 0
+                    for k, v in list(batch_cfg) + list(b["cfg"]):
+                        if k == "batch_size":
+                            gbs = int(v)
+                    assert gbs > 0, "dryrun requires batch_size"
+                    self._mon.warn_once(
+                        "dryrun_neutralized_knobs",
+                        "dist_dryrun_hosts=%d: shuffle off and "
+                        "round_batch=0 on every per-host chain (the "
+                        "bit-identity and exactly-once invariants "
+                        "need deterministic record order)"
+                        % self.dist_dryrun_hosts)
+                    it = build_dryrun_feed(b["cfg"], batch_cfg,
+                                           self.dist_dryrun_hosts, gbs)
+                    it.init()
+                    all_iters.append(it)
+                    itr_train = it
+                    continue
                 it = create_iterator(_localize(b["cfg"]), batch_cfg)
                 it.init()
                 all_iters.append(it)
@@ -418,6 +484,7 @@ class LearnTask:
                 for it in all_iters:
                     it.close()
             finally:
+                clear_dryrun_topology()
                 set_global(None)
                 self._mon.close()
 
@@ -520,6 +587,25 @@ class LearnTask:
         if monitored:
             mon.emit("run_start", **run_metadata(
                 self.task, self._cfg_stream, trainer.mesh))
+            topo = current_topology()
+            if topo.num_hosts > 1:
+                # the input/mesh topology this dist (or dryrun) run
+                # trains under (doc/distributed.md)
+                mon.emit("dist_topology", **topo.describe(),
+                         mesh=dict(trainer.mesh.shape),
+                         global_batch=trainer.batch_size)
+            if trainer.topology_changed:
+                # elastic handoff: the loaded snapshot was written
+                # under a different world size/mesh; the reader shard
+                # map re-derives at the round boundary (resume
+                # re-runs the interrupted round from its start, so
+                # the handoff record offset is 0 within the round)
+                old = trainer.resumed_topology or {}
+                mon.emit("dist_resize",
+                         old_hosts=int(old.get("hosts", 0)),
+                         new_hosts=topo.num_hosts,
+                         counter=trainer.update_counter,
+                         start_record=0)
             # batch-fetch latency histogram on the prefetch chain
             # (found anywhere in the chain, not only outermost);
             # attached only under an active monitor so the default
@@ -639,6 +725,14 @@ class LearnTask:
                         # rate of the zero-copy assembly, H2D overlap
                         # of the prefetch staging (doc/observability.md)
                         mon.emit("pipeline", round=r, **ps)
+                    if isinstance(itr_train, DryrunFeed):
+                        # per-round per-host input-shard accounting:
+                        # rows_per_host sums exactly to the round's
+                        # real rows (the exactly-once invariant,
+                        # counted per round)
+                        mon.emit("dist_shard", round=r,
+                                 **itr_train.accounting())
+                        itr_train.reset_accounting()
                 if self.test_on_server:
                     # per-round weight consistency audit (the
                     # reference's test_on_server CheckWeight_,

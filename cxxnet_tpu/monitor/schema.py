@@ -128,6 +128,25 @@ REQUIRED: Dict[str, tuple] = {
                "wall_ms"),
     "artifact_load": ("path", "fingerprint_match", "hits", "rebuilds",
                       "wall_ms"),
+    # multi-host SPMD training (doc/distributed.md): the input/mesh
+    # topology a dist (or dryrun) run trains under, the per-round
+    # per-host input-shard accounting (rows_per_host sums exactly to
+    # the round's real rows — the exactly-once invariant, counted),
+    # the elastic world-size-change handoff a resumed run detects,
+    # and the recovered process-group collective retries
+    "dist_topology": ("hosts", "local_devices", "world_devices",
+                      "dryrun", "mesh", "global_batch"),
+    "dist_shard": ("round", "hosts", "rows_per_host", "batches"),
+    "dist_resize": ("old_hosts", "new_hosts", "counter",
+                    "start_record"),
+    "dist_retry": ("what", "attempts", "recovered"),
+    # one per world size of the dryrun scaling sweep
+    # (parallel/scaling.py, the bench.py --hosts capture path behind
+    # MULTICHIP_r*.json): throughput, the data-wait share of the step
+    # wall time, and the per-host consumed-row accounting
+    "scaling_point": ("hosts", "local_devices", "global_batch",
+                      "examples_per_sec", "data_wait_share",
+                      "rows_per_host", "zero_recompiles"),
 }
 
 _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",
@@ -140,7 +159,7 @@ _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",
 
 # ratio fields must sit in [0, 1]
 _RATIO_KEYS = ("buffer_reuse_rate", "h2d_overlap_ratio", "fill_rate",
-               "pad_fraction", "agree_rate")
+               "pad_fraction", "agree_rate", "data_wait_share")
 
 
 def validate_record(rec: Dict[str, Any]) -> List[str]:

@@ -133,6 +133,17 @@ class NetTrainer:
         #                                  batch-minor cliff layout;
         #                                  applied through precompile's
         #                                  AOT lowering + device_put
+        self.dist_topology_check = "warn"  # snapshot-vs-runtime
+        #                                  topology comparison at load
+        #                                  (doc/distributed.md): warn
+        #                                  surfaces a changed mesh /
+        #                                  world size (the elastic
+        #                                  resume path), strict raises,
+        #                                  off is silent
+        self.resumed_topology = None     # the loaded snapshot's sealed
+        #                                  topology dict, when present
+        self.topology_changed = False    # load-time mismatch flag (the
+        #                                  CLI emits dist_resize off it)
         self.sample_counter = 0          # within accumulation window
         self.update_counter = 0          # applied updates (schedule epoch)
         self.round = 0
@@ -215,6 +226,11 @@ class NetTrainer:
                 self.serve_device_mem_budget = float(val)
             if name == "serve_donate":
                 self.serve_donate = int(val)
+            if name == "dist_topology_check":
+                if val not in ("off", "warn", "strict"):
+                    raise ValueError(
+                        "dist_topology_check must be off|warn|strict")
+                self.dist_topology_check = val
             if name in ("shard_optimizer", "update_on_server"):
                 # update_on_server=1 meant "optimizer state lives off the
                 # workers" (nnet_ps_server.cpp); here it means "optimizer
@@ -263,13 +279,8 @@ class NetTrainer:
                  for tag, w in pt.items()}
             for lk, pt in self.params.items()}
         if self.mesh is None:
-            # largest data-axis size that divides the global batch (the
-            # reference similarly drops devices that would get an empty
-            # slice, nnet_impl-inl.hpp:378-387)
-            ndev = len(jax.devices())
-            n_data = max(d for d in range(1, ndev + 1)
-                         if self.batch_size % d == 0)
-            self.mesh = make_mesh(n_data, 1)
+            from ..parallel import default_data_axis
+            self.mesh = make_mesh(default_data_axis(self.batch_size), 1)
         # metric bindings -> node indices
         self._metrics = MetricSet()
         self._train_metrics = MetricSet()
@@ -1804,10 +1815,69 @@ class NetTrainer:
             "update_counter": self.update_counter,
             "structure": self.graph.to_dict(),
             "cfg": self.cfg,
+            # the topology this run trained under, sealed beside the
+            # weights: resume compares it against the runtime so a
+            # silently different mesh / world size cannot slip past
+            # (dist_topology_check, doc/distributed.md) and the
+            # elastic handoff can re-derive the reader shard map from
+            # update_counter at the new world size
+            "topology": self._topology_meta(),
         }
         if self.quant_meta:
             meta["quantized"] = dict(self.quant_meta)
         return arrays, meta
+
+    def _topology_meta(self) -> Dict[str, Any]:
+        """The topology dict sealed into snapshot meta: input topology
+        (hosts/local devices, faked under the dryrun), mesh axis
+        sizes, and the global batch the shard map partitions."""
+        from ..parallel import current_topology
+        topo = current_topology().describe()
+        topo["mesh"] = {str(k): int(v)
+                        for k, v in dict(self.mesh.shape).items()} \
+            if self.mesh is not None else None
+        topo["global_batch"] = int(self.batch_size)
+        return topo
+
+    def _check_loaded_topology(self, meta: Dict[str, Any],
+                               path: str) -> None:
+        """Compare a snapshot's sealed topology against this runtime
+        (dist_topology_check): a changed mesh or world size is the
+        elastic-resume path when intentional and a data-duplication /
+        deadlock hazard when not — so it is never silent. ``warn``
+        (default) warns once and lets the resume machinery re-derive
+        the shard map; ``strict`` refuses the load."""
+        saved = meta.get("topology")
+        self.resumed_topology = saved
+        self.topology_changed = False
+        if not saved or self.dist_topology_check == "off":
+            return
+        cur = self._topology_meta()
+        # a single-host mesh resize (train on 8 devices, serve on 1)
+        # is routine and stays silent; mesh/local-device drift only
+        # matters once hosts are (or were) in play — the world-size
+        # axis itself is always compared
+        keys = ("hosts",) if saved.get("hosts", 1) <= 1 \
+            and cur.get("hosts", 1) <= 1 else \
+            ("hosts", "local_devices", "mesh")
+        diffs = [k for k in keys if saved.get(k) != cur.get(k)]
+        if not diffs:
+            return
+        self.topology_changed = True
+        desc = ", ".join("%s %r -> %r" % (k, saved.get(k), cur.get(k))
+                         for k in diffs)
+        if self.dist_topology_check == "strict":
+            raise ValueError(
+                "snapshot %s was written under a different topology "
+                "(%s) and dist_topology_check=strict refuses the "
+                "silent change; resume with dist_topology_check=warn "
+                "to accept the elastic handoff" % (path, desc))
+        from ..monitor import warn_once
+        warn_once("dist_topology_changed",
+                  "snapshot %s was written under a different topology "
+                  "(%s); the reader shard map re-derives from the "
+                  "resumed update counter at the new world size "
+                  "(doc/distributed.md)" % (path, desc))
 
     def save_model(self, path: str) -> None:
         """Synchronous verified snapshot: gather, then atomically
@@ -1867,6 +1937,9 @@ class NetTrainer:
         self.quant_tables = tables_from_blob(blob)
         self.quant_meta = dict(meta.get("quantized", {}))
         self._post_init()
+        # topology comparison AFTER _post_init: the check needs the
+        # mesh this runtime actually built (dist_topology_check)
+        self._check_loaded_topology(meta, path)
         # restore optimizer state when the snapshot carries it
         if any(k.startswith("opt/") for k in blob):
             for lk, tags in self.opt_state.items():

@@ -22,11 +22,15 @@ Capability mapping (reference -> here):
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .topology import (HostTopology, clear_dryrun_topology,
+                       current_topology, set_dryrun_topology)
 
 
 def force_virtual_cpu(n_devices: int) -> None:
@@ -58,10 +62,19 @@ def force_virtual_cpu(n_devices: int) -> None:
 
 def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
-    """Build a (data, model) mesh.
+    """Build a topology-aware (data, model) mesh.
 
-    Default: all addressable devices on the data axis — the TPU analogue
-    of ``dev = gpu:0-3`` (nnet_impl-inl.hpp:374-391).
+    Default: all devices on the data axis — the TPU analogue of
+    ``dev = gpu:0-3`` (nnet_impl-inl.hpp:374-391). Topology rule
+    (doc/distributed.md): the **data axis spans hosts x local
+    devices** and the **model axis stays within one host** — model
+    collectives run every layer and belong on ICI, never on DCN. With
+    ``jax.devices()`` returning devices in process-major order (and
+    the dryrun partitioning that order into equal virtual-host
+    blocks), a model group of ``n_model`` consecutive devices sits
+    within one host exactly when ``n_model`` divides the per-host
+    local device count — enforced here, so a config cannot silently
+    stripe its every-layer collectives across the slow interconnect.
     """
     if devices is None:
         devices = jax.devices()
@@ -71,8 +84,30 @@ def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
     use = n_data * n_model
     if use > total:
         raise ValueError("mesh wants %d devices, have %d" % (use, total))
+    topo = current_topology()
+    if n_model > 1 and topo.num_hosts > 1 \
+            and topo.local_device_count % n_model != 0:
+        raise ValueError(
+            "model axis %d does not divide the %d local devices per "
+            "host (%d hosts): the model axis must stay within a host "
+            "(ICI before DCN) — shrink n_model or repartition"
+            % (n_model, topo.local_device_count, topo.num_hosts))
     arr = np.asarray(devices[:use]).reshape(n_data, n_model)
     return Mesh(arr, ("data", "model"))
+
+
+def default_data_axis(batch_size: int,
+                      n_devices: Optional[int] = None) -> int:
+    """The trainer's default mesh rule: the largest data-axis size
+    that divides the global batch (the reference similarly drops
+    devices that would get an empty slice, nnet_impl-inl.hpp:378-387).
+    One definition shared by ``NetTrainer._post_init`` and bench.py's
+    ``--compare`` topology guard, so the recorded and expected
+    topologies cannot drift."""
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    return max(d for d in range(1, n_devices + 1)
+               if batch_size % d == 0)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -166,12 +201,22 @@ def init_distributed(coordinator: Optional[str] = None,
                       "initialize below may fail" % e)
     if not coordinator:
         return
+    if num_processes is None:
+        env = os.environ.get("CXXNET_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("CXXNET_PROCESS_ID")
+        process_id = int(env) if env else None
     try:
+        # num_processes/process_id may stay None: managed runtimes
+        # (TPU pods) let jax.distributed autodetect them — the
+        # "env-autodetected where the runtime provides them" half of
+        # the dist_* launch contract (doc/distributed.md)
         jax.distributed.initialize(
             coordinator_address=coordinator,
-            num_processes=int(num_processes
-                              or os.environ["CXXNET_NUM_PROCESSES"]),
-            process_id=int(process_id or os.environ["CXXNET_PROCESS_ID"]))
+            num_processes=None if num_processes is None
+            else int(num_processes),
+            process_id=None if process_id is None else int(process_id))
     except RuntimeError as e:
         # a launcher beat us to it (the private-module probe above can
         # miss on future jax versions); already-initialized is success
@@ -180,15 +225,60 @@ def init_distributed(coordinator: Optional[str] = None,
     _distributed_up = True
 
 
+# bounded retries for the host-side process-group collectives (the
+# eval-metric allreduce): a transient DCN hiccup re-enters the
+# collective instead of failing the round. stream_retry-style opt-out:
+# set 0 to fail fast (main.py wires `dist_allreduce_retry`, default 2)
+_allreduce_retry = 2
+_ALLREDUCE_BACKOFF_MS = 50.0
+
+
+def set_allreduce_retry(n: int) -> None:
+    global _allreduce_retry
+    _allreduce_retry = max(0, int(n))
+
+
 def allreduce_host_sum(x: np.ndarray) -> np.ndarray:
     """Sum a small host array across processes (metric reduction — the
-    rabit Allreduce in metric.h:60-68). Uses a tiny jitted psum over the
-    global device set."""
+    rabit Allreduce in metric.h:60-68) via a process allgather.
+
+    Transient failures (collective timeout, coordination-service
+    blips — the DCN failure modes that surface as RuntimeError/OSError
+    on every participant) retry up to ``set_allreduce_retry`` times
+    with exponential backoff, warn once, and emit a ``dist_retry``
+    record on recovery. Retrying a collective is only sound when all
+    ranks retry: these transport failures DO surface fleet-wide, and a
+    lone rank whose peers somehow advanced times out again, exhausts
+    its budget, and raises — the metric layer then falls back to
+    process-local values as before (utils/metric.py). Exhaustion
+    re-raises; this is a bounded retry, not a swallow."""
     if jax.process_count() == 1:
         return x
     from jax.experimental import multihost_utils
-    return np.asarray(
-        multihost_utils.process_allgather(x).sum(axis=0))
+    attempts = 0
+    while True:
+        try:
+            out = np.asarray(
+                multihost_utils.process_allgather(x).sum(axis=0))
+        except (RuntimeError, OSError) as e:
+            attempts += 1
+            if attempts > _allreduce_retry:
+                raise
+            from ..monitor import warn_once
+            warn_once("allreduce_retry",
+                      "process-group allreduce failed transiently "
+                      "(%s: %s); retrying up to %d time(s)"
+                      % (type(e).__name__, e, _allreduce_retry))
+            time.sleep(_ALLREDUCE_BACKOFF_MS * (2 ** (attempts - 1))
+                       / 1e3)
+            continue
+        if attempts:
+            from ..monitor import get_global
+            mon = get_global()
+            if mon is not None and mon.enabled:
+                mon.emit("dist_retry", what="allreduce_host_sum",
+                         attempts=attempts, recovered=True)
+        return out
 
 
 def synced_batches(it, window: int = 1):
