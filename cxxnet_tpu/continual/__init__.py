@@ -1,0 +1,16 @@
+"""Continual train-while-serve: the production loop in one process.
+
+``task = continual`` composes the subsystems that until now only ran
+one-shot — the trainer (nnet), the crash-safe checkpoint writer
+(nnet/checkpoint), sealed-artifact export (artifact/bundle), and the
+fleet front end with its hot-swap watcher (serve/frontend, serve/swap)
+— into one long-lived supervisor: train on a looping iterator while
+the fleet serves live traffic, and every ``continual_export_every``
+updates run the generation pipeline (eval gate -> verified snapshot ->
+sealed bundle -> watcher ``notify()`` -> zero-downtime flip),
+continuously for N generations instead of once. See doc/continual.md.
+"""
+
+from .loop import ContinualConfig, ContinualLoop, GenerationExporter
+
+__all__ = ["ContinualConfig", "ContinualLoop", "GenerationExporter"]

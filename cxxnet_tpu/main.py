@@ -2,7 +2,9 @@
 
 Parity with ``/root/reference/src/cxxnet_main.cpp:26-575``: a config file
 plus ``key=value`` CLI overrides drives tasks ``train`` / ``finetune`` /
-``pred`` / ``extract_feature`` / ``get_weight``; snapshots are written as
+``pred`` / ``extract_feature`` / ``get_weight`` (plus the TPU-port tasks
+``serve`` / ``serve_fleet`` / ``fleet`` / ``quantize`` / ``export`` /
+``continual``); snapshots are written as
 ``<model_dir>/<round:04d>.model.npz``; ``continue=1`` resumes from the
 latest snapshot (SyncLastestModel, :180-202); ``test_io=1`` exercises the
 data pipeline without the net (:455-468); only the root process saves
@@ -118,6 +120,13 @@ class LearnTask:
         # output bundle directory; "" derives NNNN.model.bundle beside
         # model_in so a watched model_dir picks the bundle up
         self.export_out = ""
+        # finetune remap contract (doc/tasks.md "finetune"): layers
+        # named here re-initialize fresh (the new-label-count head);
+        # any OTHER shape mismatch is a typed FinetuneShapeError
+        # naming the layer unless finetune_strict = 0 restores the
+        # reference's silent skip-and-reinit
+        self.finetune_remap: Tuple[str, ...] = ()
+        self.finetune_strict = 1
         # multi-host SPMD launch (doc/distributed.md): coordinator
         # address + world shape driving jax.distributed.initialize.
         # Env vars (CXXNET_COORDINATOR et al.) and managed-runtime
@@ -138,6 +147,7 @@ class LearnTask:
         self._mon = Monitor()
         self._cfg_stream = []
         self._resume_report = None
+        self._resume_found = False
         # preemption flag set from the SIGTERM/SIGINT handler; holds
         # the signal number until the train loop's next update boundary
         self._preempt_signum: Optional[int] = None
@@ -212,6 +222,11 @@ class LearnTask:
             self.quantize_out = val
         if name == "export_out":
             self.export_out = val
+        if name == "finetune_remap":
+            self.finetune_remap = tuple(
+                t.strip() for t in val.split(",") if t.strip())
+        if name == "finetune_strict":
+            self.finetune_strict = int(val)
         if name == "dist_coordinator":
             self.dist_coordinator = val
         if name == "dist_num_hosts":
@@ -316,6 +331,7 @@ class LearnTask:
 
             if self.continue_training:
                 latest = self._sync_latest_model()
+                self._resume_found = latest is not None
                 if latest is not None:
                     self.model_in = latest
                 rep = self._resume_report
@@ -440,14 +456,36 @@ class LearnTask:
                 return self._task_quantize(cfg, pred_iter or itr_train)
 
             trainer = NetTrainer(cfg)
-            if self.task in ("train", "finetune"):
-                if self.model_in and self.task == "train":
+            if self.task in ("train", "finetune", "continual"):
+                # monitor BEFORE init/load: the finetune carry record
+                # and a bundle model_in's artifact_load accounting are
+                # emitted during the bootstrap below
+                trainer.set_monitor(self._mon)
+                mode = self.task
+                if self.task == "continual":
+                    # the loop's training mode (continual_task):
+                    # train = fresh init / warm-start model_in;
+                    # finetune = remap-aware bootstrap
+                    from .continual import ContinualConfig
+                    mode = ContinualConfig(cfg).task
+                if self.model_in and (mode == "train"
+                                      or self._resume_found):
+                    # plain verified load — including a resumed
+                    # (continue = 1) finetune/continual run: its own
+                    # snapshots already carry the remapped structure,
+                    # so resume must NOT re-remap a freshly
+                    # initialized head over the trained one
                     trainer.load_model(self.model_in)
                 else:
                     trainer.init_model()
-                    if self.task == "finetune":
+                    if mode == "finetune":
                         assert self.model_in, "finetune requires model_in"
-                        trainer.copy_model_from(self.model_in)
+                        trainer.finetune_from(
+                            self.model_in, remap=self.finetune_remap,
+                            strict=bool(self.finetune_strict))
+                if self.task == "continual":
+                    return self._task_continual(cfg, trainer,
+                                                itr_train, eval_iters)
                 return self._task_train(trainer, itr_train, eval_iters)
 
             assert self.model_in, "task %s requires model_in" % self.task
@@ -577,7 +615,9 @@ class LearnTask:
     def _task_train(self, trainer, itr_train, eval_iters) -> int:
         assert itr_train is not None, "train requires a data block"
         mon = self._mon
-        trainer.set_monitor(mon)
+        if trainer._mon is not mon:      # run() may have attached it
+            trainer.set_monitor(mon)     # already (no duplicate
+            #                              model_info records)
         if hasattr(itr_train, "set_transform"):
             # threadbuffer chains overlap host->device transfer with
             # device compute by device_put-ing in the prefetch thread
@@ -758,6 +798,62 @@ class LearnTask:
             c = trainer.counters_snapshot()
             mon.emit("run_end", wall_s=time.time() - start,
                      steps=int(c["steps"]), examples=int(c["examples"]))
+        return 0
+
+    def _task_continual(self, cfg, trainer, itr_train,
+                        eval_iters) -> int:
+        """Continual train-while-serve (doc/continual.md): one
+        long-lived process trains on a looping iterator while the
+        fleet front end serves live traffic from ``model_dir``; every
+        ``continual_export_every`` updates the generation pipeline
+        runs (eval gate -> verified snapshot -> sealed bundle ->
+        watcher ``notify()`` -> zero-downtime flip), for
+        ``continual_generations`` generations. SIGTERM/SIGINT takes
+        the emergency-snapshot exit (code 75) like ``task = train``."""
+        assert itr_train is not None, "continual requires a data block"
+        assert world_size() == 1, \
+            "task=continual must run single-process"
+        from .continual import ContinualLoop
+        mon = self._mon
+        if hasattr(itr_train, "set_transform"):
+            # same prefetch-thread H2D overlap as _task_train: the
+            # long-lived trainer must not pay serialized transfers
+            itr_train.set_transform(trainer.device_put_batch)
+        if mon.enabled:
+            mon.emit("run_start", **run_metadata(
+                "continual", self._cfg_stream, trainer.mesh))
+        handlers = []
+        try:
+            handlers = self._install_preempt_handlers()
+            loop = ContinualLoop(
+                cfg, trainer, itr_train, eval_iters,
+                model_dir=self.model_dir,
+                path_for=self._model_path,
+                monitor=mon,
+                should_stop=lambda: self._preempt_signum is not None,
+                checkpoint_async=bool(self.checkpoint_async),
+                checkpoint_fsync=bool(self.checkpoint_fsync),
+                keep_snapshots=self.keep_snapshots,
+                start_counter=self.start_counter,
+                dispatch_period=self.dispatch_period)
+            summary = loop.run()
+        finally:
+            self._restore_handlers(handlers)
+        if summary["preempted"]:
+            signum = int(self._preempt_signum or 0)
+            if self.silent == 0 and is_root():
+                mon.line("continual: preempted by signal %d after %d "
+                         "generation(s); emergency snapshot committed"
+                         % (signum, summary["deployed"]))
+            if mon.enabled:
+                mon.emit("preempt", signal=signum,
+                         round=trainer.round,
+                         exit_code=EXIT_PREEMPTED)
+            return EXIT_PREEMPTED
+        if mon.enabled:
+            mon.emit("task_end", task="continual",
+                     generations=summary["deployed"],
+                     requests=summary["requests"])
         return 0
 
     def _task_serve(self, cfg, itr) -> int:

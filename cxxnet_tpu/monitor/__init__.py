@@ -14,6 +14,10 @@ them, configured through the same ``key = value`` config grammar:
   line — one file is one run's stream).
 - ``monitor_flush_period`` — seconds between sink flushes (0 = flush
   every record).
+- ``monitor_rotate_mb`` — size bound on the live JSONL file (0 =
+  unbounded); crossing it atomically rotates to ``<path>.<n>`` so a
+  long-lived ``task = continual`` process cannot grow one unbounded
+  stream.
 - ``monitor_trace_dir`` — when set, a ``jax.profiler`` trace is
   captured into this directory over a round window, so a perf trace is
   one config line away.
@@ -32,6 +36,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import sys
 import threading
 import time
@@ -92,13 +97,42 @@ class JsonlSink:
     truncated per run — one file is one run's stream (re-running with
     the same monitor_path must not interleave runs, and the schema's
     monotonic-step check reads one run at a time); point monitor_path
-    at distinct files to keep history."""
+    at distinct files to keep history.
+
+    ``rotate_mb`` > 0 bounds the live file: once a record write takes
+    it past the limit, the file atomically rotates to
+    ``<path>.<n>`` (``os.replace`` — a reader tailing the live path
+    sees the old stream or the new one, never a torn file) and a
+    fresh ``<path>`` continues the run. A long-lived ``task =
+    continual`` process would otherwise grow one unbounded file
+    (``monitor_rotate_mb``, doc/observability.md). Rotation failure
+    (read-only dir, cross-device quirk) warns once on stderr and
+    keeps appending to the current file — losing the bound, never the
+    records."""
 
     enabled = True
 
-    def __init__(self, path: str, flush_period: float = 1.0):
+    def __init__(self, path: str, flush_period: float = 1.0,
+                 rotate_mb: float = 0.0):
         self.path = path
         self.flush_period = max(0.0, float(flush_period))
+        self.rotate_bytes = int(max(0.0, float(rotate_mb)) * 1e6)
+        self.rotations = 0
+        self._written = 0
+        self._rotate_broken = False
+        # one file set = one run: a re-run reusing this monitor_path
+        # truncates the live file, so any rotated segments of a
+        # previous run must go too — a stale <path>.<n> would
+        # interleave two runs' streams for any consumer walking the
+        # segment chain. Unconditional: a rerun with rotation OFF
+        # must not inherit the rotated history either.
+        n = 1
+        while True:
+            try:
+                os.remove("%s.%d" % (path, n))
+            except OSError:
+                break                    # first gap ends the chain
+            n += 1
         self._f = open(path, "w")
         self._last_flush = time.monotonic()
         # serve workers emit from several threads into one stream;
@@ -109,10 +143,52 @@ class JsonlSink:
         line = json.dumps(record, sort_keys=True) + "\n"
         with self._wlock:
             self._f.write(line)
+            self._written += len(line)
+            if self.rotate_bytes and self._written >= self.rotate_bytes:
+                self._rotate_locked()
             now = time.monotonic()
             if now - self._last_flush >= self.flush_period:
                 self._f.flush()
                 self._last_flush = now
+
+    def _rotate_locked(self) -> None:
+        """Rotate under ``_wlock``: flush, atomically rename the live
+        file aside, reopen a fresh one. Record boundaries only — a
+        record never splits across files. NEVER raises: a sink
+        failure must not take down the run it observes (the warn_once
+        discipline, but latched locally — routing through the monitor
+        would re-enter this sink)."""
+        if self._rotate_broken:
+            return
+        try:
+            self._f.flush()
+            target = "%s.%d" % (self.path, self.rotations + 1)
+            os.replace(self.path, target)
+        except OSError as e:
+            self._rotate_broken = True   # warn once, keep appending
+            sys.stderr.write(
+                "[cxxnet_tpu monitor] warning monitor_rotate_failed: "
+                "could not rotate %r (%s); the stream keeps appending "
+                "to the current file without a size bound\n"
+                % (self.path, e))
+            return
+        old = self._f
+        try:
+            self._f = open(self.path, "w")
+        except OSError as e:
+            # the rename committed but a fresh file will not open:
+            # fall back to the (renamed) old handle — still a valid
+            # stream, just no longer at the live path
+            self._f = old
+            self._rotate_broken = True
+            sys.stderr.write(
+                "[cxxnet_tpu monitor] warning monitor_rotate_failed: "
+                "rotated %r but could not reopen it (%s); records "
+                "continue into the rotated file\n" % (self.path, e))
+            return
+        old.close()
+        self.rotations += 1
+        self._written = 0
 
     def flush(self) -> None:
         with self._wlock:
@@ -362,6 +438,7 @@ def create_monitor(cfg, root: Optional[bool] = None) -> Monitor:
     mode = "none"
     path = "monitor.jsonl"
     flush_period = 1.0
+    rotate_mb = 0.0
     trace_dir = ""
     trace_begin, trace_end = 1, None
     for name, val in cfg:
@@ -374,6 +451,8 @@ def create_monitor(cfg, root: Optional[bool] = None) -> Monitor:
             path = val
         if name == "monitor_flush_period":
             flush_period = float(val)
+        if name == "monitor_rotate_mb":
+            rotate_mb = float(val)
         if name == "monitor_trace_dir":
             trace_dir = val
         if name == "monitor_trace_begin":
@@ -392,7 +471,7 @@ def create_monitor(cfg, root: Optional[bool] = None) -> Monitor:
     if mode == "stdout":
         sink = StdoutSink()
     elif mode == "jsonl":
-        sink = JsonlSink(path, flush_period)
+        sink = JsonlSink(path, flush_period, rotate_mb=rotate_mb)
     else:
         sink = NullSink()
     return Monitor(sink, trace_dir=trace_dir, trace_begin=trace_begin,

@@ -147,6 +147,17 @@ REQUIRED: Dict[str, tuple] = {
     "scaling_point": ("hosts", "local_devices", "global_batch",
                       "examples_per_sec", "data_wait_share",
                       "rows_per_host", "zero_recompiles"),
+    # continual train-while-serve (doc/continual.md): the per-layer
+    # finetune carry accounting (task=finetune and the loop's
+    # bootstrap), one record per generation attempt (the gate
+    # decision trail — "deployed" rows carry the gated eval value the
+    # soak's monotone check reads), and the loop's close-time rollup
+    "finetune": ("source", "source_digest", "carried", "remapped",
+                 "fresh", "frozen_groups"),
+    "generation": ("generation", "counter", "action", "metric",
+                   "value", "train_updates", "path", "wall_ms"),
+    "continual": ("generations", "deployed", "gate_skipped",
+                  "updates", "swaps", "wall_s"),
 }
 
 _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",

@@ -53,6 +53,24 @@ from .net import FuncNet
 
 _RE_METRIC = re.compile(r"^metric(?:\[([^\]]*)\])?$")
 
+
+class FinetuneShapeError(ValueError):
+    """A finetune source holds a parameter whose shape no longer
+    matches the configured net and the layer was NOT declared in
+    ``finetune_remap`` — the message names the layer so the fix is one
+    config line. ``layer`` / ``tag`` carry the offending group."""
+
+    def __init__(self, layer: str, tag: str, saved_shape, new_shape):
+        self.layer = layer
+        self.tag = tag
+        super().__init__(
+            "finetune: layer %r param %r changed shape %s -> %s but is "
+            "not listed in finetune_remap — declare it "
+            "(finetune_remap = %s) for a fresh re-init, or fix the net "
+            "config (finetune_strict = 0 restores the silent "
+            "skip-and-reinit behavior)"
+            % (layer, tag, tuple(saved_shape), tuple(new_shape), layer))
+
 # the one non-f32 float staging dtype _ship passes through unconverted
 # (bf16-warmed serve ladders; numpy spells it via ml_dtypes through jnp)
 _BF16 = np.dtype(jnp.bfloat16)
@@ -1617,8 +1635,18 @@ class NetTrainer:
 
     def evaluate(self, data_iter, name: str) -> str:
         """Run a full eval pass; returns '\\t<name>-<metric>:<value>'."""
+        return self.evaluate_metrics(data_iter, name)[0]
+
+    def evaluate_metrics(self, data_iter, name: str
+                         ) -> Tuple[str, Dict[str, float]]:
+        """One eval pass returning BOTH the parity line and the
+        ``{tag: value}`` dict — one reduction per metric serves the
+        line, the structured ``eval`` record, and machine consumers
+        (the continual loop's eval gate reads the dict; re-running
+        ``results()`` would double the collective count under
+        multi-process runs)."""
         if not self._metrics.evals:
-            return ""
+            return "", {}
         self._metrics.clear()
         nodes_wanted = tuple(self._metric_nodes)
         from ..parallel import synced_batches
@@ -1640,13 +1668,14 @@ class NetTrainer:
                 pred_np, self._label_fields(self._host_label(batch),
                                             nvalid))
         res = self._metrics.results()
+        vals = {t: float(v) for t, v in res}
         if self._mon_on() and res:
             # structured record beside the parity line; ONE reduction
             # per metric serves both (results() is collective under
             # multi-process runs)
             self._mon.emit("eval", round=self.round, name=name,
-                           metrics={t: float(v) for t, v in res})
-        return MetricSet.format_line(name, res)
+                           metrics=vals)
+        return MetricSet.format_line(name, res), vals
 
     @staticmethod
     def rows_to_prediction(m: np.ndarray) -> np.ndarray:
@@ -1984,33 +2013,150 @@ class NetTrainer:
         if self._mon_on():
             self._mon.emit("artifact_load", **rep)
 
-    def copy_model_from(self, path: str) -> None:
-        """Finetune: copy weights for layers whose *names* match
-        (nnet_impl-inl.hpp:117-150). Call after init_model."""
+    @staticmethod
+    def _read_source_blob(path: str):
+        """Digest-verified (arrays, meta) of a finetune/reload source:
+        a plain snapshot, or a sealed artifact bundle resolved to its
+        inner snapshot (the bundle's member verification runs first,
+        then the snapshot's own content digest — doc/artifacts.md)."""
+        from ..artifact import bundle as _ab
         from .checkpoint import read_snapshot
-        assert self._initialized
-        blob, _ = read_snapshot(path)
-        copied = []
+        if _ab.is_bundle(path):
+            b = _ab.load_bundle(path)
+            return read_snapshot(b.snapshot_uri, raw=b.snapshot_raw)
+        return read_snapshot(path)
+
+    def finetune_from(self, path: str, remap: Sequence[str] = (),
+                      strict: bool = True) -> Dict[str, Any]:
+        """The ``task = finetune`` bootstrap (doc/tasks.md): carry
+        weights over from a verified snapshot or sealed bundle into a
+        freshly initialized net, remapping the layers named in
+        ``remap`` (fresh init — the new-label-count output head) and
+        digest-verifying everything carried (``read_snapshot`` refuses
+        a source whose content digest fails).
+
+        Call after ``init_model``. Carry-over is by layer *name* with
+        exact shape equality (nnet_impl-inl.hpp:117-150); a layer whose
+        saved shape no longer matches and is NOT in ``remap`` raises
+        :class:`FinetuneShapeError` naming it (``strict=False``
+        restores the reference's silent skip-and-reinit). Returns (and
+        emits as the ``finetune`` record) the carry accounting."""
+        assert self._initialized, "call init_model first"
+        blob, meta = self._read_source_blob(path)
+        remap_set = set(remap)
+        unknown = remap_set - set(self.params.keys())
+        if unknown:
+            raise ValueError(
+                "finetune_remap names unknown param layer(s) %s; "
+                "known: %s" % (sorted(unknown), sorted(self.params)))
+        carried = self._carry_from_blob(blob, remap_set, strict)
+        fresh = sorted(remap_set)
+        frozen = sorted(set(
+            lk for lk, tags in self.updaters.items()
+            for tag, upd in tags.items() if upd.param.lr_mult == 0.0))
+        rec = {
+            "source": path,
+            "source_digest": str(meta.get("content_digest", "")),
+            "carried": len(carried), "remapped": len(fresh),
+            "fresh": sorted(set(self.params) - set(carried)
+                            - remap_set),
+            "carried_layers": carried, "remapped_layers": fresh,
+            "frozen_groups": frozen,
+        }
+        if self.silent == 0:
+            print("finetune_from %s: carried %s; remapped %s%s"
+                  % (path, ", ".join(carried) or "<none>",
+                     ", ".join(fresh) or "<none>",
+                     ("; frozen %s" % ", ".join(frozen)) if frozen
+                     else ""))
+        if self._mon_on():
+            self._mon.emit("finetune", **rec)
+        return rec
+
+    def _carry_from_blob(self, blob, remap_set, strict: bool):
+        """The ONE name+shape carry loop behind ``finetune_from`` and
+        ``copy_model_from`` (params + net_state, ``_put_all``,
+        residency invalidation) — a fix to the carry semantics cannot
+        silently miss one of them. Returns the carried layer keys."""
+        carried = []
         for lk, pt in self.params.items():
+            if lk in remap_set:
+                continue                 # declared remap: fresh init
             hit = {}
             for tag in pt:
                 k = "param/%s/%s" % (lk, tag)
-                if k in blob and blob[k].shape == tuple(pt[tag].shape):
-                    hit[tag] = jnp.asarray(blob[k])
+                if k not in blob:
+                    continue
+                if blob[k].shape != tuple(pt[tag].shape):
+                    if strict:
+                        raise FinetuneShapeError(
+                            lk, tag, blob[k].shape, pt[tag].shape)
+                    continue             # legacy: skip, keep fresh init
+                hit[tag] = jnp.asarray(blob[k])
             if hit:
                 newp = dict(self.params[lk])
                 newp.update(hit)
                 self.params[lk] = newp
-                copied.append(lk)
+                carried.append(lk)
+        for lk, st in self.net_state.items():
+            if lk in remap_set:
+                continue                 # remapped layers keep fresh state
+            for kk in st:
+                k = "state/%s/%s" % (lk, kk)
+                if k in blob and blob[k].shape == tuple(st[kk].shape):
+                    st[kk] = jnp.asarray(blob[k])
+        self._put_all()
+        self.programs.residency = None   # frozen serve tree is stale
+        return carried
+
+    def load_weights_inplace(self, path: str) -> None:
+        """Refresh params/net_state/update_counter from a verified
+        snapshot (or bundle) WITHOUT rebuilding the graph or the
+        dispatch programs — every array must match an existing leaf's
+        shape exactly. The continual exporter's per-generation reload:
+        the bucket-ladder executables (weight-agnostic; weights are
+        arguments) stay valid, so generation exports after the first
+        compile zero new programs (doc/continual.md)."""
+        assert self._initialized, "call init_model/load_model first"
+        blob, meta = self._read_source_blob(path)
+        for lk, pt in self.params.items():
+            newp = dict(pt)
+            for tag in pt:
+                k = "param/%s/%s" % (lk, tag)
+                if k not in blob:
+                    continue
+                if blob[k].shape != tuple(pt[tag].shape):
+                    raise ValueError(
+                        "load_weights_inplace: %s:%s shape %s does not "
+                        "match the live net's %s — in-place reload "
+                        "requires an identical structure (use "
+                        "load_model for a structural change)"
+                        % (lk, tag, blob[k].shape,
+                           tuple(pt[tag].shape)))
+                newp[tag] = jnp.asarray(blob[k])
+            self.params[lk] = newp
         for lk, st in self.net_state.items():
             for kk in st:
                 k = "state/%s/%s" % (lk, kk)
                 if k in blob and blob[k].shape == tuple(st[kk].shape):
                     st[kk] = jnp.asarray(blob[k])
-        if self.silent == 0 and copied:
-            print("copy_model_from: copied layers %s" % ", ".join(copied))
+        self.update_counter = int(meta.get("update_counter",
+                                           self.update_counter))
         self._put_all()
         self.programs.residency = None   # frozen serve tree is stale
+
+    def copy_model_from(self, path: str) -> None:
+        """Finetune: copy weights for layers whose *names* match with
+        identical shapes, silently skipping the rest
+        (nnet_impl-inl.hpp:117-150). Call after init_model. The
+        remap-aware, typed-error front end over the same carry loop
+        is :meth:`finetune_from` (the ``task = finetune`` path)."""
+        from .checkpoint import read_snapshot
+        assert self._initialized
+        blob, _ = read_snapshot(path)
+        copied = self._carry_from_blob(blob, set(), strict=False)
+        if self.silent == 0 and copied:
+            print("copy_model_from: copied layers %s" % ", ".join(copied))
 
     @property
     def last_loss(self) -> float:

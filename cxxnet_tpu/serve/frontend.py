@@ -811,6 +811,14 @@ class FleetServer:
         if c.port_file:
             self._write_port_file(c.port_file)
 
+    def notify_watchers(self) -> None:
+        """Kick every hot-swap watcher for an immediate poll — the
+        in-process exporter's post-commit hook (the continual loop
+        calls this right after sealing a generation bundle so the flip
+        does not wait out ``serve_swap_poll_s``; doc/continual.md)."""
+        for w in self._watchers:
+            w.notify()
+
     def _write_port_file(self, path: str) -> None:
         """Atomically publish the resolved listen ports (tmp +
         rename): a fleet controller polling for this file must never

@@ -114,6 +114,11 @@ class SnapshotWatcher:
         self.poll_s = max(0.05, float(poll_s))
         self._mon = monitor
         self._stop = threading.Event()
+        # the notify() kick: an in-process writer (the continual
+        # exporter) wakes the poll thread the instant its artifact
+        # commits instead of waiting out poll_s; polling stays for
+        # external writers (a training run in another process)
+        self._kick = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # serializes the scan->build->flip->drain sequence AND guards
         # the public counters: check_once runs on the poll thread but
@@ -285,8 +290,22 @@ class SnapshotWatcher:
             daemon=True)
         self._thread.start()
 
+    def notify(self) -> None:
+        """Wake the poll thread for an immediate check — the
+        in-process writer's post-commit kick (a notify that lands
+        while a check is already running simply schedules one more
+        pass, so a commit can never fall into the poll gap). Safe
+        from any thread; a no-op before ``start()`` beyond making the
+        first poll immediate. External writers keep the plain
+        ``poll_s`` cadence — they have no handle to call this."""
+        self._kick.set()
+
     def _loop(self) -> None:
-        while not self._stop.wait(self.poll_s):
+        while True:
+            self._kick.wait(self.poll_s)
+            self._kick.clear()
+            if self._stop.is_set():
+                return
             try:
                 self.check_once()
             except Exception as e:
@@ -298,6 +317,7 @@ class SnapshotWatcher:
 
     def close(self) -> None:
         self._stop.set()
+        self._kick.set()                 # wake a sleeping poll NOW
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None

@@ -15,6 +15,15 @@ Behavior parity with ``/root/reference/src/updater/param.h:12-136``:
   clamped), which differs only transiently.
 - schedule quirk kept exactly: when ``epoch < start_epoch`` the LR is
   ``base_lr`` (reset applied after the minimum clamp, param.h:90-94).
+- layer-group LR scaling: ``lr_mult`` multiplies the scheduled LR of
+  this (layer, tag) group AFTER the schedule/minimum/start_epoch
+  machinery, so a group's multiplier composes with any schedule.
+  ``wmult`` / ``bmult`` are the reference-style aliases scoped to the
+  ``wmat`` / ``bias`` tags; ``lr_mult`` itself tag-scopes like every
+  other key (``wmat:lr_mult``). ``lr_mult = 0`` freezes the group —
+  with a zero-initialized momentum buffer the weights stay
+  bit-identical across updates (the finetune frozen-backbone case,
+  doc/tasks.md "finetune").
 """
 
 from __future__ import annotations
@@ -42,6 +51,9 @@ class UpdaterParam:
     final_momentum: float = 0.90
     saturation_epoch: int = 0
     clip_gradient: float = 0.0
+    # per-group LR multiplier (lr_mult / wmult / bmult): applied after
+    # the schedule, 0 freezes the group (finetune layer groups)
+    lr_mult: float = 1.0
     silent: int = 0
     # adam extras (adam_updater-inl.hpp:24-26: decay = 1 - beta)
     decay1: float = 0.1
@@ -75,13 +87,25 @@ class UpdaterParam:
         self.learning_rate = max(lr, self.lr_minimum)
         if epoch < self.start_epoch:
             self.learning_rate = self.base_lr
+        # group multiplier LAST so it composes with every schedule
+        # (and lr_mult = 0 wins over the minimum-LR clamp: a frozen
+        # group must see exactly 0, not lr_minimum)
+        self.learning_rate *= self.lr_mult
 
     def set_param(self, name: str, val: str) -> None:
+        # reference-style group multipliers BEFORE the tag strip: they
+        # carry their tag in the key itself (wmult = wmat, bmult = bias)
+        if name == "wmult" and self.tag == "wmat":
+            self.lr_mult = float(val)
+        if name == "bmult" and self.tag == "bias":
+            self.lr_mult = float(val)
         # tag prefix strip: "wmat:lr" with tag=="wmat" -> "lr"
         if self.tag and name.startswith(self.tag):
             rest = name[len(self.tag):]
             if rest.startswith(":"):
                 name = rest[1:]
+        if name == "lr_mult":
+            self.lr_mult = float(val)
         if name in ("lr", "eta"):
             self.base_lr = float(val)
         if name == "wd":

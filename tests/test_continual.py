@@ -1,0 +1,422 @@
+"""Continual train-while-serve (doc/continual.md): the N-generation
+CPU soak — trainer and fleet front end in ONE ``task = continual``
+process, every generation hot-swapping under concurrent client load
+with zero failed requests and zero post-warmup compiles on the
+swapped-in engines, the gated eval metric monotone non-worsening in
+the telemetry stream — plus the loop's unit surfaces (config
+validation, the eval gate's keep-serving semantics, the watcher's
+``notify()`` kick)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.main import main
+from cxxnet_tpu.monitor import MemorySink, Monitor
+from cxxnet_tpu.monitor.schema import read_jsonl, validate_records
+from tests.test_trainer import synth_idx
+
+CONT_CONF = """
+data = train
+iter = mnist
+  path_img = "%s"
+  path_label = "%s"
+  shuffle = 1
+  silent = 1
+iter = end
+
+eval = test
+iter = mnist
+  path_img = "%s"
+  path_label = "%s"
+  silent = 1
+iter = end
+
+netconfig=start
+layer[+1:h] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.05
+layer[+1] = relu
+layer[h->o] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+
+input_shape = 1,1,256
+batch_size = 50
+eta = 0.1
+momentum = 0.9
+metric[label] = error
+model_dir = "%s"
+print_step = 0
+silent = 1
+
+task = continual
+continual_generations = 3
+continual_export_every = 6
+continual_gate_eps = 0.05
+continual_linger_s = 3.0
+dispatch_period = 3
+serve_buckets = 1,4
+serve_max_batch = 4
+serve_max_delay_ms = 1
+serve_http_port = -1
+serve_binary_port = 0
+serve_swap_poll_s = 30
+serve_port_file = "%s"
+monitor = jsonl
+monitor_path = "%s"
+monitor_flush_period = 0
+%s
+"""
+
+
+def write_cont_conf(tmp_path, extra=""):
+    pimg, plab = synth_idx(str(tmp_path), n=300, name="tr")
+    pimg2, plab2 = synth_idx(str(tmp_path), n=100, seed=5, name="te")
+    conf = CONT_CONF % (pimg, plab, pimg2, plab2,
+                        str(tmp_path / "models"),
+                        str(tmp_path / "ports.json"),
+                        str(tmp_path / "mon.jsonl"), extra)
+    p = str(tmp_path / "cont.conf")
+    with open(p, "w") as f:
+        f.write(conf)
+    return p
+
+
+def test_continual_soak_three_generations(tmp_path):
+    """THE acceptance soak: one process trains while its fleet serves;
+    generations 2 and 3 hot-swap under live closed-loop binary
+    clients (zero failed requests), every swapped-in engine records
+    zero post-warmup compiles, and the gated eval value per deployed
+    generation is monotone non-worsening in the stream."""
+    import json
+
+    from cxxnet_tpu.serve import BinaryClient
+
+    conf = write_cont_conf(tmp_path)
+    rc = {}
+
+    def run():
+        # not the main thread: signal handlers are skipped by design
+        rc["code"] = main([conf])
+
+    runner = threading.Thread(target=run, name="continual-main")
+    runner.start()
+
+    # wait for the fleet to come up (generation 1 boots it), then
+    # hammer it with closed-loop clients for the rest of the run
+    port_file = tmp_path / "ports.json"
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline and not port_file.exists():
+        assert runner.is_alive(), "continual run died before serving"
+        time.sleep(0.05)
+    assert port_file.exists(), "fleet never published its ports"
+    port = json.loads(port_file.read_text())["binary_port"]
+
+    stop = threading.Event()
+    counts = {"ok": 0, "shed": 0}
+    failures = []
+    lock = threading.Lock()
+    pool = np.random.RandomState(0).rand(16, 256).astype(np.float32)
+
+    def client(ci):
+        bc = BinaryClient("127.0.0.1", port, timeout=120)
+        try:
+            while not stop.is_set():
+                rows = pool[(ci * 3) % 12:(ci * 3) % 12 + 2]
+                try:
+                    status, out = bc.predict(rows, tenant="t%d" % ci)
+                except Exception as e:   # transport failure = dropped
+                    with lock:
+                        failures.append(repr(e))
+                    return
+                with lock:
+                    if status == "ok":
+                        counts["ok"] += 1
+                    elif status in ("busy", "over_quota"):
+                        counts["shed"] += 1
+                    else:
+                        failures.append((status, out))
+        finally:
+            bc.close()
+
+    clients = [threading.Thread(target=client, args=(i,))
+               for i in range(3)]
+    for t in clients:
+        t.start()
+    try:
+        # generations 2..3 deploy while this traffic runs; the final
+        # linger window lets us stop the clients BEFORE the drain
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            try:
+                recs = [r for r in read_jsonl(str(tmp_path
+                                                  / "mon.jsonl"))
+                        if r.get("event") == "generation"
+                        and r.get("action") == "deployed"]
+            except (IOError, OSError, ValueError):
+                recs = []                # mid-write torn tail: retry
+            if len(recs) >= 3:
+                break
+            if not runner.is_alive():
+                break
+            time.sleep(0.1)
+    finally:
+        stop.set()
+        for t in clients:
+            t.join(timeout=120)
+    runner.join(timeout=300)
+    assert not runner.is_alive()
+    assert rc["code"] == 0
+
+    records = read_jsonl(str(tmp_path / "mon.jsonl"))
+    assert validate_records(records, strict=False) == []
+
+    # three deployed generations, each swapped-in engine compile-free
+    gens = [r for r in records if r["event"] == "generation"]
+    deployed = [r for r in gens if r["action"] == "deployed"]
+    assert len(deployed) == 3, gens
+    assert [r["generation"] for r in deployed] == [1, 2, 3]
+    assert all(r["swap_compile_events"] == 0 for r in deployed)
+    assert deployed[0]["boot"] and not deployed[1]["boot"]
+    assert all(r["swapped"] for r in deployed[1:])
+
+    # generations 2 and 3 were real hot-swaps (counter n-1 -> n)
+    swaps = [r for r in records if r["event"] == "hot_swap"]
+    assert [(s["old_counter"], s["new_counter"]) for s in swaps] \
+        == [(1, 2), (2, 3)]
+
+    # the gated eval metric is monotone non-worsening (min mode:
+    # non-increasing within the configured eps) across deployments
+    vals = [r["value"] for r in deployed]
+    eps = 0.05
+    assert all(b <= a + eps for a, b in zip(vals, vals[1:])), vals
+
+    # the loop rollup agrees and saw zero post-warmup serve compiles
+    roll = [r for r in records if r["event"] == "continual"]
+    assert len(roll) == 1
+    assert roll[0]["deployed"] == 3 and roll[0]["swaps"] == 2
+    assert roll[0]["serve_compile_events"] == 0
+    assert not roll[0]["preempted"]
+
+    # ZERO failed requests under swap; traffic actually flowed
+    assert failures == [], failures[:5]
+    assert counts["ok"] > 10, counts
+
+    # artifacts on disk: snapshot + sealed bundle per generation
+    names = sorted(os.listdir(tmp_path / "models"))
+    for c in (1, 2, 3):
+        assert "%04d.model.npz" % c in names
+        assert "%04d.model.bundle" % c in names
+
+
+def test_continual_gate_skip_keeps_serving(tmp_path):
+    """A failed eval gate skips snapshot AND export: the fleet keeps
+    serving the old generation and the attempt is recorded. A
+    negative eps makes every post-first attempt fail
+    deterministically; continual_max_updates bounds the run."""
+    conf = write_cont_conf(
+        tmp_path,
+        extra=("continual_gate_eps = -1000000\n"
+               "continual_generations = 2\n"
+               "continual_max_updates = 18\n"
+               "continual_linger_s = 0\n"))
+    assert main([conf]) == 0
+    records = read_jsonl(str(tmp_path / "mon.jsonl"))
+    assert validate_records(records, strict=False) == []
+    gens = [r for r in records if r["event"] == "generation"]
+    assert [r["action"] for r in gens][:1] == ["deployed"]
+    skipped = [r for r in gens if r["action"] == "gate_skipped"]
+    assert skipped, gens
+    # no artifacts beyond generation 1 — the gate kept the old one
+    names = sorted(os.listdir(tmp_path / "models"))
+    assert names == ["0001.model.bundle", "0001.model.npz"], names
+    roll = [r for r in records if r["event"] == "continual"][0]
+    assert roll["deployed"] == 1 and roll["gate_skipped"] >= 1
+    assert roll["swaps"] == 0
+
+
+def test_continual_config_validation():
+    from cxxnet_tpu.continual import ContinualConfig
+    with pytest.raises(ValueError, match="continual_export_every"):
+        ContinualConfig([("continual_generations", "3")])
+    with pytest.raises(ValueError, match="min|max|off"):
+        ContinualConfig([("continual_export_every", "5"),
+                         ("continual_gate", "sideways")])
+    with pytest.raises(ValueError, match="train|finetune"):
+        ContinualConfig([("continual_export_every", "5"),
+                         ("continual_task", "serve")])
+    cc = ContinualConfig([("continual_export_every", "5"),
+                          ("continual_gate", "max"),
+                          ("continual_gate_eps", "0.1")])
+    assert cc.passes(0.5, None)          # first generation always
+    assert cc.passes(0.45, 0.5)          # within eps
+    assert not cc.passes(0.3, 0.5)       # worse beyond eps (max mode)
+
+
+def test_continual_gate_needs_eval_block(tmp_path):
+    """continual_gate != off without an eval iterator is a config
+    error, not a silent ungated loop."""
+    from cxxnet_tpu.continual import ContinualLoop
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config
+    from tests.test_trainer import MLP_CONF
+    cfg = parse_config(MLP_CONF) + [("continual_export_every", "5")]
+    trainer = NetTrainer(cfg)
+    with pytest.raises(ValueError, match="eval"):
+        ContinualLoop(cfg, trainer, itr_train=object(), eval_iters=[],
+                      model_dir=str(tmp_path),
+                      path_for=lambda c: str(tmp_path / str(c)))
+
+
+# -- the watcher notify() kick -------------------------------------------
+
+
+class _FakeSession:
+    """Minimal stand-in for a warmed ServeSession."""
+
+    warmup_programs = 1
+
+    def __init__(self, path):
+        self.path = path
+        self.closed = False
+
+    def close(self, drain=True):
+        self.closed = True
+        return {"requests": 0, "compile_events": 0}
+
+
+def _commit_snapshot(path):
+    from cxxnet_tpu.nnet.checkpoint import write_snapshot
+    write_snapshot(str(path), {"param/fc/wmat":
+                               np.zeros((2, 2), np.float32)},
+                   {"update_counter": 1})
+
+
+def test_watcher_notify_triggers_immediate_check(tmp_path):
+    """notify() wakes the poll thread NOW: with a 60 s poll period, a
+    snapshot committed after start() flips within a bounded wait only
+    because of the kick (the poll alone would take a minute). close()
+    also returns promptly — it must not wait out the period either."""
+    from cxxnet_tpu.serve.router import ModelRouter
+    from cxxnet_tpu.serve.swap import SnapshotWatcher
+    d = tmp_path / "models"
+    d.mkdir()
+    _commit_snapshot(d / "0001.model.npz")
+    router = ModelRouter()
+    router.register("m", _FakeSession(str(d / "0001.model.npz")),
+                    counter=1, path=str(d / "0001.model.npz"))
+    w = SnapshotWatcher(router, "m", str(d),
+                        builder=lambda p: _FakeSession(p),
+                        poll_s=60.0)
+    w.start()
+    try:
+        time.sleep(0.2)                  # poll thread is asleep now
+        _commit_snapshot(d / "0002.model.npz")
+        t0 = time.monotonic()
+        w.notify()
+        deadline = t0 + 10
+        while time.monotonic() < deadline and w.swaps == 0:
+            time.sleep(0.02)
+        waited = time.monotonic() - t0
+        assert w.swaps == 1, "notify() did not trigger a check"
+        assert waited < 10, waited
+        assert router.resolve("m").counter == 2
+    finally:
+        t0 = time.monotonic()
+        w.close()
+        assert time.monotonic() - t0 < 10, "close() waited out poll_s"
+
+
+def test_watcher_notify_before_start_is_safe(tmp_path):
+    """notify() before start() must not crash and must not leak a
+    stuck state — the first poll simply runs immediately."""
+    from cxxnet_tpu.serve.router import ModelRouter
+    from cxxnet_tpu.serve.swap import SnapshotWatcher
+    d = tmp_path / "models"
+    d.mkdir()
+    _commit_snapshot(d / "0001.model.npz")
+    router = ModelRouter()
+    router.register("m", _FakeSession(str(d / "0001.model.npz")),
+                    counter=1, path=str(d / "0001.model.npz"))
+    w = SnapshotWatcher(router, "m", str(d),
+                        builder=lambda p: _FakeSession(p),
+                        poll_s=60.0)
+    w.notify()                           # before start: just a kick
+    _commit_snapshot(d / "0002.model.npz")
+    w.start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and w.swaps == 0:
+            time.sleep(0.02)
+        assert w.swaps == 1
+    finally:
+        w.close()
+
+
+# -- the generation exporter's zero-compile reload ------------------------
+
+
+def test_exporter_reuses_engine_across_generations(tmp_path):
+    """Generation 2+ exports reload weights in place: zero new
+    programs compile after the first generation's warmup, and the
+    re-sealed bundle carries the NEW weights."""
+    from cxxnet_tpu.continual import GenerationExporter
+    from cxxnet_tpu.nnet.checkpoint import read_snapshot
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config
+    from tests.test_trainer import MLP_CONF
+    cfg = parse_config(MLP_CONF) + [("serve_buckets", "1,4"),
+                                    ("serve_max_batch", "4")]
+    trainer = NetTrainer(cfg)
+    trainer.init_model()
+    s1 = str(tmp_path / "0001.model.npz")
+    trainer.save_model(s1)
+    # a second, different snapshot (perturbed weights)
+    w = trainer.get_weight("fc1", "wmat")
+    trainer.set_weight("fc1", "wmat", w + 1.0)
+    s2 = str(tmp_path / "0002.model.npz")
+    trainer.save_model(s2)
+
+    sink = MemorySink()
+    ex = GenerationExporter(cfg, monitor=Monitor(sink))
+    ex.export(s1, str(tmp_path / "0001.model.bundle"))
+    assert ex.compiled_programs > 0
+    compiles_before = len([r for r in sink.records
+                           if r["event"] == "compile"])
+    stats2 = ex.export(s2, str(tmp_path / "0002.model.bundle"))
+    compiles_after = len([r for r in sink.records
+                          if r["event"] == "compile"])
+    assert compiles_after == compiles_before, \
+        "generation-2 export recompiled"
+    assert stats2["programs"] == ex.compiled_programs
+    # the re-sealed bundle holds the NEW weights
+    from cxxnet_tpu.artifact.bundle import load_bundle
+    b = load_bundle(str(tmp_path / "0002.model.bundle"))
+    blob, _ = read_snapshot(b.snapshot_uri, raw=b.snapshot_raw)
+    ref, _ = read_snapshot(s2)
+    np.testing.assert_array_equal(blob["param/fc1/wmat"],
+                                  ref["param/fc1/wmat"])
+
+
+def test_load_weights_inplace_rejects_structure_change(tmp_path):
+    """In-place reload is shape-strict: a mismatched source names the
+    offending layer and leaves no half-written tree semantics (the
+    caller falls back to load_model)."""
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.utils.config import parse_config
+    from tests.test_trainer import MLP_CONF
+    trainer = NetTrainer(parse_config(MLP_CONF))
+    trainer.init_model()
+    other = NetTrainer(parse_config(
+        MLP_CONF.replace("nhidden = 4", "nhidden = 6")))
+    other.init_model()
+    src = str(tmp_path / "other.npz")
+    other.save_model(src)
+    with pytest.raises(ValueError, match="fc2"):
+        trainer.load_weights_inplace(src)

@@ -1,0 +1,248 @@
+"""``task = finetune`` (doc/tasks.md): remap-aware carry-over from a
+verified snapshot or sealed bundle — typed shape-mismatch errors
+naming the layer, layer-group LR scaling (``lr_mult`` / ``wmult`` /
+``bmult``) with bit-identical frozen groups, resume preserving the
+remap, and the end-to-end bundle -> remap -> train -> export -> boot
+acceptance path with zero compile events on the matching-runtime
+boot."""
+
+import os
+
+import numpy as np
+import pytest
+
+from cxxnet_tpu.main import main
+from cxxnet_tpu.monitor import MemorySink, Monitor
+from cxxnet_tpu.monitor.schema import read_jsonl, validate_records
+from cxxnet_tpu.nnet.checkpoint import read_snapshot
+from cxxnet_tpu.nnet.trainer import FinetuneShapeError, NetTrainer
+from tests.test_main import write_conf
+from tests.test_trainer import synth_idx
+
+
+@pytest.fixture
+def setup(tmp_path):
+    """A trained 4-class source model + its sealed bundle + a 6-class
+    finetune conf whose head (fc2) is remapped and whose backbone
+    (fc1) carries a group multiplier."""
+    pimg, plab = synth_idx(str(tmp_path), n=300, name="tr")
+    pimg2, plab2 = synth_idx(str(tmp_path), n=100, seed=5, name="te")
+    conf = write_conf(tmp_path, pimg, plab, pimg2, plab2,
+                      extra="serve_buckets = 1,4\n"
+                            "serve_max_batch = 4\n")
+    assert main([conf, "num_round=1"]) == 0
+    model = str(tmp_path / "models" / "0001.model.npz")
+    assert main([conf, "task=export", "model_in=" + model]) == 0
+    bundle = str(tmp_path / "models" / "0001.model.bundle")
+    assert os.path.isdir(bundle)
+
+    # 6-class head + per-group LR scaling on the carried backbone
+    conf6 = (tmp_path / "run.conf").read_text() \
+        .replace("layer[h->o] = fullc:fc2\n  nhidden = 4",
+                 "layer[h->o] = fullc:fc2\n  nhidden = 6\n"
+                 "  lr_mult = 4") \
+        .replace("layer[+1:h] = fullc:fc1\n  nhidden = 32",
+                 "layer[+1:h] = fullc:fc1\n  nhidden = 32\n"
+                 "  wmult = 0.1\n  bmult = 0.1")
+    p6 = str(tmp_path / "run6.conf")
+    with open(p6, "w") as f:
+        f.write(conf6)
+    return tmp_path, conf, p6, model, bundle
+
+
+def test_finetune_bundle_remap_end_to_end(setup):
+    """The acceptance path: load the exported BUNDLE, remap the head
+    to 6 classes, train with per-group LR scaling, export, boot the
+    new bundle — carried weights digest-verified and bit-equal at the
+    bootstrap, remapped head freshly sized, zero compile events on
+    the matching-runtime boot."""
+    tmp_path, conf, p6, model, bundle = setup
+    mdir = str(tmp_path / "ft")
+    mon_file = str(tmp_path / "ft.jsonl")
+    assert main([p6, "task=finetune", "model_in=" + bundle,
+                 "finetune_remap=fc2", "num_round=1",
+                 "model_dir=" + mdir, "monitor=jsonl",
+                 "monitor_path=" + mon_file]) == 0
+    records = read_jsonl(mon_file)
+    assert validate_records(records, strict=False) == []
+    ft = [r for r in records if r["event"] == "finetune"]
+    assert len(ft) == 1
+    rec = ft[0]
+    assert rec["source"] == bundle
+    assert rec["carried_layers"] == ["fc1"]
+    assert rec["remapped_layers"] == ["fc2"]
+    assert rec["source_digest"].startswith("sha256:")
+    # the source was loaded through its digest-verified read path:
+    # the digest in the record is the source snapshot's sealed one
+    _, src_meta = read_snapshot(model)
+    assert rec["source_digest"] == src_meta["content_digest"]
+
+    # remapped head is 6-wide; carried backbone left the source
+    # bit-identical at the bootstrap (round 0 weights == source)
+    snap, _ = read_snapshot(os.path.join(mdir, "0001.model.npz"))
+    assert snap["param/fc2/wmat"].shape == (32, 6)
+    assert snap["param/fc2/bias"].shape == (6,)
+
+    # export the finetuned model and boot the new bundle: matching
+    # runtime deserializes every program — zero compile events
+    ft_model = os.path.join(mdir, "0001.model.npz")
+    assert main([p6, "task=export", "model_in=" + ft_model]) == 0
+    ft_bundle = os.path.join(mdir, "0001.model.bundle")
+    from cxxnet_tpu.artifact.bundle import serve_cfg_from_bundle
+    from cxxnet_tpu.serve import ServeSession
+    sink = MemorySink()
+    session = ServeSession(serve_cfg_from_bundle(ft_bundle),
+                           model_path=ft_bundle,
+                           monitor=Monitor(sink))
+    try:
+        out = session.predict(np.zeros((2, 256), np.float32))
+        assert out.shape == (2, 6)       # the remapped head serves
+    finally:
+        session.close()
+    compiles = [r for r in sink.records if r["event"] == "compile"]
+    assert compiles == [], compiles
+    art = [r for r in sink.records if r["event"] == "artifact_load"]
+    assert len(art) == 1 and art[0]["fingerprint_match"]
+    assert art[0]["hits"] > 0 and art[0]["rebuilds"] == 0
+
+
+def test_shape_mismatch_without_remap_is_typed_and_names_layer(setup):
+    """A changed layer NOT declared in finetune_remap raises
+    FinetuneShapeError naming it; finetune_strict=0 restores the
+    reference's silent skip-and-reinit."""
+    tmp_path, conf, p6, model, bundle = setup
+    with pytest.raises(FinetuneShapeError) as ei:
+        main([p6, "task=finetune", "model_in=" + model,
+              "num_round=1", "model_dir=" + str(tmp_path / "e")])
+    assert ei.value.layer == "fc2"
+    assert "fc2" in str(ei.value)
+    assert "finetune_remap" in str(ei.value)
+    # non-strict: the mismatched head silently re-inits (legacy)
+    assert main([p6, "task=finetune", "model_in=" + model,
+                 "finetune_strict=0", "num_round=1",
+                 "model_dir=" + str(tmp_path / "ns")]) == 0
+    snap, _ = read_snapshot(str(tmp_path / "ns" / "0001.model.npz"))
+    assert snap["param/fc2/wmat"].shape == (32, 6)
+
+
+def test_unknown_remap_layer_is_an_error(setup):
+    tmp_path, conf, p6, model, bundle = setup
+    with pytest.raises(ValueError, match="ghost"):
+        main([p6, "task=finetune", "model_in=" + model,
+              "finetune_remap=ghost", "num_round=1",
+              "model_dir=" + str(tmp_path / "g")])
+
+
+def test_frozen_group_is_bit_identical_after_updates(setup):
+    """lr_mult = 0 freezes a layer group: after N real updates its
+    weights are BIT-identical to the carried source (momentum starts
+    at zero and the scheduled LR is exactly zero — not lr_minimum)."""
+    tmp_path, conf, p6, model, bundle = setup
+    frozen = (tmp_path / "run6.conf").read_text() \
+        .replace("  wmult = 0.1\n  bmult = 0.1", "  lr_mult = 0")
+    pf = str(tmp_path / "frozen.conf")
+    with open(pf, "w") as f:
+        f.write(frozen)
+    mdir = str(tmp_path / "fr")
+    assert main([pf, "task=finetune", "model_in=" + model,
+                 "finetune_remap=fc2", "num_round=2",
+                 "model_dir=" + mdir]) == 0
+    src, _ = read_snapshot(model)
+    out, _ = read_snapshot(os.path.join(mdir, "0002.model.npz"))
+    # frozen backbone: bitwise unchanged across 2 rounds of updates
+    np.testing.assert_array_equal(src["param/fc1/wmat"],
+                                  out["param/fc1/wmat"])
+    np.testing.assert_array_equal(src["param/fc1/bias"],
+                                  out["param/fc1/bias"])
+    # the remapped head DID train (lr_mult 4 on fc2)
+    assert out["param/fc2/wmat"].shape == (32, 6)
+    assert float(np.abs(out["param/fc2/wmat"]).sum()) > 0
+
+
+def test_resume_preserves_remap(setup):
+    """continue=1 on a finetune run resumes the run's OWN snapshot —
+    the remapped head survives instead of being re-initialized from
+    the original model_in. Proven bit-exactly: the resumed round runs
+    with every group frozen, so 0002 must equal 0001 (a re-remap
+    would have re-initialized fc2)."""
+    tmp_path, conf, p6, model, bundle = setup
+    frozen = (tmp_path / "run6.conf").read_text() \
+        .replace("  wmult = 0.1\n  bmult = 0.1", "  lr_mult = 0") \
+        .replace("  lr_mult = 4", "  lr_mult = 0")
+    pf = str(tmp_path / "frozen_all.conf")
+    with open(pf, "w") as f:
+        f.write(frozen)
+    mdir = str(tmp_path / "rs")
+    assert main([pf, "task=finetune", "model_in=" + model,
+                 "finetune_remap=fc2", "num_round=1",
+                 "model_dir=" + mdir]) == 0
+    assert main([pf, "task=finetune", "model_in=" + model,
+                 "finetune_remap=fc2", "continue=1", "num_round=2",
+                 "model_dir=" + mdir]) == 0
+    a, _ = read_snapshot(os.path.join(mdir, "0001.model.npz"))
+    b, _ = read_snapshot(os.path.join(mdir, "0002.model.npz"))
+    assert b["param/fc2/wmat"].shape == (32, 6)
+    # everything frozen: the resumed round must carry 0001's weights
+    # forward bit-exactly — including the remapped head
+    for k in ("param/fc1/wmat", "param/fc1/bias",
+              "param/fc2/wmat", "param/fc2/bias"):
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_lr_mult_and_aliases_scope_to_groups():
+    """Unit surface: lr_mult composes with the schedule; wmult/bmult
+    scope to their tags; tag-scoped wmat:lr_mult works; lr_mult=0
+    beats the minimum-LR clamp."""
+    from cxxnet_tpu.updater.param import UpdaterParam
+    p = UpdaterParam(tag="wmat")
+    p.set_param("lr", "0.5")
+    p.set_param("lr_mult", "0.1")
+    p.schedule_epoch(0)
+    assert p.learning_rate == pytest.approx(0.05)
+
+    p = UpdaterParam(tag="wmat")
+    p.set_param("lr", "0.5")
+    p.set_param("wmult", "2")
+    p.set_param("bmult", "7")            # wrong tag: ignored
+    p.schedule_epoch(0)
+    assert p.learning_rate == pytest.approx(1.0)
+
+    p = UpdaterParam(tag="bias")
+    p.set_param("lr", "0.5")
+    p.set_param("wmult", "2")            # wrong tag: ignored
+    p.set_param("bmult", "3")
+    p.schedule_epoch(0)
+    assert p.learning_rate == pytest.approx(1.5)
+
+    p = UpdaterParam(tag="bias")
+    p.set_param("lr", "0.5")
+    p.set_param("wmat:lr_mult", "9")     # other tag's scoped key
+    p.set_param("bias:lr_mult", "0")
+    p.schedule_epoch(0)
+    assert p.learning_rate == 0.0        # exact zero, not lr_minimum
+
+
+def test_trainer_finetune_from_plain_snapshot_matches_copy(tmp_path):
+    """With no remap and identical structure, finetune_from carries
+    exactly what copy_model_from carried (back-compat with the
+    reference's name+shape matching)."""
+    from cxxnet_tpu.utils.config import parse_config
+    from tests.test_trainer import MLP_CONF
+    src = NetTrainer(parse_config(MLP_CONF))
+    src.init_model()
+    path = str(tmp_path / "src.npz")
+    src.save_model(path)
+
+    a = NetTrainer(parse_config(MLP_CONF), mesh=src.mesh)
+    a.init_model()
+    rec = a.finetune_from(path)
+    assert sorted(rec["carried_layers"]) == ["fc1", "fc2"]
+    assert rec["remapped_layers"] == [] and rec["frozen_groups"] == []
+    b = NetTrainer(parse_config(MLP_CONF), mesh=src.mesh)
+    b.init_model()
+    b.copy_model_from(path)
+    for lk in ("fc1", "fc2"):
+        for tag in ("wmat", "bias"):
+            np.testing.assert_array_equal(
+                np.asarray(a.params[lk][tag]),
+                np.asarray(b.params[lk][tag]), err_msg=lk + tag)

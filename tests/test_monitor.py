@@ -3,6 +3,7 @@ stdout parity, and the CI smoke run (one tiny train with
 ``monitor = jsonl`` whose every record is schema-validated)."""
 
 import json
+import os
 import re
 
 import numpy as np
@@ -76,6 +77,75 @@ def test_jsonl_sink_flush_and_close(tmp_path):
     recs = read_jsonl(p)                       # visible pre-close
     assert len(recs) == 1 and recs[0]["round"] == 2
     sink.close()
+
+
+def test_jsonl_sink_rotation(tmp_path):
+    """monitor_rotate_mb bounds the live file: crossing the limit
+    atomically rotates to <path>.<n> at a record boundary and a fresh
+    file continues the run — no record lost, none split across
+    files."""
+    p = str(tmp_path / "r.jsonl")
+    # stale segments from a "previous run" must be cleared at init
+    # (one file set = one run), not left to interleave two streams
+    for n in (1, 2, 3):
+        with open("%s.%d" % (p, n), "w") as f:
+            f.write('{"event": "stale", "run": "previous"}\n')
+    # ~0.0005 MB = 500 bytes: a few records per segment
+    sink = JsonlSink(p, flush_period=0.0, rotate_mb=0.0005)
+    mon = Monitor(sink)
+    for i in range(40):
+        mon.emit("round_start", round=i, pad="x" * 64)
+    mon.close()
+    assert sink.rotations >= 2
+    segs = [str(tmp_path / ("r.jsonl.%d" % (n + 1)))
+            for n in range(sink.rotations)]
+    rounds = []
+    for f in segs + [p]:
+        recs = read_jsonl(f)             # every segment parses whole
+        # rotated segments are never empty; the live file may be (the
+        # last record can itself trigger the rotation)
+        assert recs or f == p, "empty segment %s" % f
+        rounds += [r["round"] for r in recs]
+    assert rounds == list(range(40))     # nothing lost, order kept
+    # no segment beyond this run's rotations survives (stale cleanup)
+    assert not os.path.exists("%s.%d" % (p, sink.rotations + 1))
+    # every rotated segment respects the bound (+ one record of slack:
+    # rotation triggers on the write that crosses it)
+    for f in segs:
+        assert os.path.getsize(f) <= 500 + 200, f
+
+
+def test_jsonl_sink_rotation_failure_warns_once_and_keeps_writing(
+        tmp_path, capsys, monkeypatch):
+    """A failed rotation (read-only dir, EXDEV quirk) must not take
+    down the run it observes: one stderr warning, then the stream
+    keeps appending unbounded to the current file."""
+    p = str(tmp_path / "f.jsonl")
+    sink = JsonlSink(p, flush_period=0.0, rotate_mb=0.0001)
+
+    def boom(src, dst):
+        raise OSError("no rotation today")
+
+    monkeypatch.setattr(os, "replace", boom)
+    mon = Monitor(sink)
+    for i in range(30):
+        mon.emit("round_start", round=i)
+    mon.close()
+    err = capsys.readouterr().err
+    assert err.count("monitor_rotate_failed") == 1   # warned ONCE
+    assert sink.rotations == 0
+    recs = read_jsonl(p)                 # all records in the one file
+    assert [r["round"] for r in recs] == list(range(30))
+
+
+def test_create_monitor_rotate_key(tmp_path):
+    m = create_monitor(
+        [("monitor", "jsonl"),
+         ("monitor_path", str(tmp_path / "x.jsonl")),
+         ("monitor_rotate_mb", "2.5")], root=True)
+    assert isinstance(m.sink, JsonlSink)
+    assert m.sink.rotate_bytes == int(2.5e6)
+    m.close()
 
 
 def test_create_monitor_modes(tmp_path):

@@ -394,6 +394,226 @@ def run_multi_tenant(args, monitor, sink):
     return rec, slo_ok, zero_recompiles
 
 
+# -- continual train-while-serve soak (--generations) ---------------------
+
+
+def _write_soak_idx(td, n=300, d=16, nclass=4, seed=0, name=""):
+    """Learnable synthetic idx dataset (class k lights up image block
+    k): the continual soak needs training that actually improves so
+    the eval gate has something real to pass."""
+    import struct
+    rng = np.random.RandomState(seed)
+    lab = rng.randint(0, nclass, size=(n,)).astype(np.uint8)
+    img = rng.randint(0, 60, size=(n, d, d), dtype=np.uint8)
+    blk = d // nclass
+    for i in range(n):
+        k = lab[i]
+        img[i, :, k * blk:(k + 1) * blk] = np.minimum(
+            img[i, :, k * blk:(k + 1) * blk] + 180, 255)
+    pimg = os.path.join(td, "img%s.idx3" % name)
+    plab = os.path.join(td, "lab%s.idx1" % name)
+    with open(pimg, "wb") as f:
+        f.write(struct.pack(">iiii", 0x803, n, d, d))
+        f.write(img.tobytes())
+    with open(plab, "wb") as f:
+        f.write(struct.pack(">ii", 0x801, n))
+        f.write(lab.tobytes())
+    return pimg, plab
+
+
+SOAK_NET = """
+netconfig=start
+layer[+1:h] = fullc:fc1
+  nhidden = 32
+  init_sigma = 0.05
+layer[+1] = relu
+layer[h->o] = fullc:fc2
+  nhidden = 4
+  init_sigma = 0.05
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,256
+batch_size = 50
+eta = 0.1
+momentum = 0.9
+metric[label] = error
+"""
+
+
+def run_continual_soak(args, monitor, sink):
+    """``--generations N``: the continual train-while-serve
+    acceptance soak (doc/continual.md). One process trains while its
+    fleet serves; closed-loop binary clients hammer it across every
+    hot-swap. Returns (record, clean, zero_recompiles):
+
+    - ``clean`` is False (exit 3) on ANY dropped/failed client
+      request, a generation that did not deploy+flip, or a
+      non-monotone gated eval across deployed generations;
+    - ``zero_recompiles`` is False (exit 1) on any post-warmup
+      compile on a serving engine (swapped-in engines included).
+    """
+    import tempfile
+    import threading
+
+    from cxxnet_tpu.continual import ContinualLoop
+    from cxxnet_tpu.io import create_iterator
+    from cxxnet_tpu.monitor.schema import validate_records
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.serve import BinaryClient
+    from cxxnet_tpu.utils.config import parse_config
+
+    n_gen = int(args.generations)
+    sink.clear()
+    with tempfile.TemporaryDirectory() as td:
+        pimg, plab = _write_soak_idx(td, n=300, name="tr")
+        pimg2, plab2 = _write_soak_idx(td, n=100, seed=5, name="te")
+        model_dir = os.path.join(td, "models")
+        cfg = parse_config(SOAK_NET) + [
+            ("continual_generations", str(n_gen)),
+            ("continual_export_every", "6"),
+            ("continual_gate_eps", "0.05"),
+            ("continual_linger_s", "3"),
+            ("serve_buckets", args.buckets if args.buckets != "auto"
+             else "1,4"),
+            ("serve_max_batch", "4"),
+            ("serve_max_delay_ms", str(args.max_delay_ms)),
+            ("serve_http_port", "-1"),
+            ("serve_binary_port", "0"),
+            ("serve_swap_poll_s", "30"),   # the notify() kick, not
+            #                                the poll, drives swaps
+            ("silent", "1"),
+        ]
+        batch_cfg = [("batch_size", "50"),
+                     ("input_shape", "1,1,256")]
+        itr_train = create_iterator(
+            [("iter", "mnist"), ("path_img", pimg),
+             ("path_label", plab), ("shuffle", "1"), ("silent", "1")],
+            batch_cfg)
+        itr_train.init()
+        itr_eval = create_iterator(
+            [("iter", "mnist"), ("path_img", pimg2),
+             ("path_label", plab2), ("silent", "1")], batch_cfg)
+        itr_eval.init()
+        trainer = NetTrainer(cfg)
+        trainer.set_monitor(monitor)
+        trainer.init_model()
+
+        deployed_done = threading.Event()
+        ngen_seen = {"deployed": 0}
+
+        def on_generation(rec):
+            if rec.get("action") == "deployed":
+                ngen_seen["deployed"] += 1
+                if ngen_seen["deployed"] >= n_gen:
+                    deployed_done.set()  # stop clients inside linger
+
+        loop = ContinualLoop(
+            cfg, trainer, itr_train, [("test", itr_eval)],
+            model_dir=model_dir,
+            path_for=lambda c: os.path.join(
+                model_dir, "%04d.model.npz" % c),
+            monitor=monitor, on_generation=on_generation,
+            dispatch_period=3)
+        summary = {}
+
+        def run_loop():
+            summary.update(loop.run())
+
+        lt = threading.Thread(target=run_loop, name="continual-loop")
+        lt.start()
+
+        # clients come up once generation 1 boots the fleet
+        deadline = time.time() + 600
+        while time.time() < deadline and lt.is_alive() \
+                and (loop.fleet is None or loop.fleet.binary_port <= 0):
+            time.sleep(0.05)
+        counts = {"ok": 0, "shed": 0}
+        failures = []
+        lock = threading.Lock()
+        clients = []
+        if loop.fleet is not None and loop.fleet.binary_port > 0:
+            port = loop.fleet.binary_port
+            rng = np.random.RandomState(0)
+            pool = rng.rand(16, 256).astype(np.float32)
+
+            def client(ci):
+                bc = BinaryClient("127.0.0.1", port, timeout=120)
+                try:
+                    while not deployed_done.is_set():
+                        rows = pool[(ci * 3) % 12:(ci * 3) % 12
+                                    + args.request_rows]
+                        try:
+                            status, out = bc.predict(rows)
+                        except Exception as e:
+                            with lock:
+                                failures.append(repr(e))
+                            return
+                        with lock:
+                            if status == "ok":
+                                counts["ok"] += 1
+                            elif status in ("busy", "over_quota"):
+                                counts["shed"] += 1
+                            else:
+                                failures.append((status, out))
+                finally:
+                    bc.close()
+
+            clients = [threading.Thread(target=client, args=(i,))
+                       for i in range(3)]
+            for t in clients:
+                t.start()
+        lt.join(timeout=600)
+        deployed_done.set()
+        for t in clients:
+            t.join(timeout=120)
+        alive = lt.is_alive()
+
+    errs = validate_records(sink.records)
+    assert not errs, "schema-invalid continual telemetry: %s" % errs[:5]
+    gens = [r for r in sink.records if r["event"] == "generation"]
+    deployed = [r for r in gens if r["action"] == "deployed"]
+    vals = [r["value"] for r in deployed]
+    eps = 0.05
+    monotone = all(b <= a + eps for a, b in zip(vals, vals[1:]))
+    # the loop's rollup already folds every engine's final counter
+    # (swapped-in engines included) exactly once — the per-record
+    # swap_compile_events are point-in-time samples of the same
+    # counters, not an additional total
+    serve_compiles = int(summary.get("serve_compile_events", 0))
+    clean = (not alive and not failures
+             and len(deployed) == n_gen and monotone
+             and int(summary.get("swaps", 0)) == n_gen - 1)
+    rec = {
+        "name": "serve_bench", "mode": "continual", "t": time.time(),
+        "model": "synthetic_mlp_256_32_4",
+        "generations": n_gen,
+        "generations_deployed": len(deployed),
+        "gate_skipped": int(summary.get("gate_skipped", 0)),
+        "hot_swaps": int(summary.get("swaps", 0)),
+        "train_updates": int(summary.get("updates", 0)),
+        "eval_values": [round(v, 5) for v in vals],
+        "eval_monotone": monotone,
+        "requests_ok": counts["ok"],
+        "requests_shed": counts["shed"],
+        "requests_failed": len(failures),
+        "wall_s": round(float(summary.get("wall_s", 0.0)), 2),
+        "zero_failed_requests": not failures,
+        "zero_recompiles": serve_compiles == 0,
+    }
+    for g in deployed:
+        print("# generation %d: %s=%.4f, %s, swap compiles %d"
+              % (g["generation"], g["metric"], g["value"],
+                 "boot" if g.get("boot") else
+                 "hot-swap %.2fs" % g.get("swap_wall_s", 0.0),
+                 g.get("swap_compile_events", 0)), file=sys.stderr)
+    print("# continual soak: %d/%d deployed, %d swaps, %d ok / %d "
+          "shed / %d failed, monotone=%s, serve compiles %d"
+          % (len(deployed), n_gen, rec["hot_swaps"], counts["ok"],
+             counts["shed"], len(failures), monotone, serve_compiles),
+          file=sys.stderr)
+    return rec, clean, serve_compiles == 0
+
+
 # -- multi-replica fleet scenario (--replicas) ----------------------------
 
 
@@ -1060,6 +1280,15 @@ def main(argv=None) -> int:
                          "behind the balancer, plus a kill-a-replica-"
                          "mid-traffic assertion (zero failed "
                          "requests) at the largest N")
+    ap.add_argument("--generations", type=int, default=0,
+                    help="continual train-while-serve soak "
+                         "(doc/continual.md): run a task=continual-"
+                         "style loop for N generations with closed-"
+                         "loop clients hammering the fleet across "
+                         "every hot-swap; exits 3 on any dropped "
+                         "request or a non-improving gated eval, 1 "
+                         "on post-warmup compiles (the existing "
+                         "exit-code convention)")
     ap.add_argument("--fleet-clients-per-replica", type=int,
                     default=4,
                     help="with --replicas: closed-loop clients per "
@@ -1128,6 +1357,10 @@ def main(argv=None) -> int:
     if args.replicas and args.tenants:
         ap.error("--replicas and --tenants are separate scenarios; "
                  "run them as two invocations")
+    if args.generations and (args.replicas or args.tenants
+                             or args.artifact):
+        ap.error("--generations is its own scenario; drop "
+                 "--replicas/--tenants/--artifact")
     if args.autoscale_soak and not args.replicas:
         ap.error("--autoscale-soak needs --replicas")
     if (args.coalesce_ms or args.fleet_baseline) \
@@ -1138,6 +1371,20 @@ def main(argv=None) -> int:
     import jax
     sink = MemorySink()
     monitor = Monitor(sink)
+    if args.generations:
+        rec, clean, zero_recompiles = run_continual_soak(
+            args, monitor, sink)
+        rec["platform"] = jax.default_backend()
+        out = json.dumps(rec, sort_keys=True)
+        print(out)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(out + "\n")
+        # exit-code convention: 1 = post-warmup compiles, 3 = the
+        # soak dropped requests / failed a deploy / eval regressed
+        if not zero_recompiles:
+            return 1
+        return 0 if clean else 3
     if args.replicas:
         rec, clean, zero_recompiles = run_multi_replica(
             args, monitor, sink)
