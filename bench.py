@@ -179,6 +179,27 @@ def dtype_mismatches(old, new_dtype):
     return out
 
 
+def sync_mismatches(old, new_grad_sync, new_optim_shard):
+    """Models whose prior record carries a gradient-sync mode or
+    optimizer-shard setting DIFFERENT from this sweep's — a
+    grad_sync=overlap capture must never silently diff against a fused
+    baseline (the schedule is the variable under test), and ZeRO-1
+    changes the update's memory traffic. Refused (exit 2, the
+    dtype/topology convention) unless --allow-sync-mismatch; untagged
+    old records (pre-grad_sync rounds) compare freely."""
+    out = []
+    for m, v in sorted(old.items()):
+        if not isinstance(v, dict):
+            continue
+        osync = v.get("grad_sync")
+        if osync is not None and osync != new_grad_sync:
+            out.append((m, "grad_sync", osync, new_grad_sync))
+        oshard = v.get("optim_shard")
+        if oshard is not None and int(oshard) != int(new_optim_shard):
+            out.append((m, "optim_shard", oshard, new_optim_shard))
+    return out
+
+
 # bench model -> (builder in cxxnet_tpu.models, default batch, image
 # size, model-specific config); image sizes follow the reference confs:
 # AlexNet 227 (ImageNet/README.md), Inception-BN and kaiming 224.
@@ -212,7 +233,9 @@ def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
             dtype: str = "bfloat16",
             grad_dtype: str = "bfloat16",
             extra: tuple = (), builder_kw: dict = None,
-            peak_tflops: float = 0.0) -> float:
+            peak_tflops: float = 0.0,
+            grad_sync: str = "fused",
+            optim_shard: int = 0) -> float:
     import jax
     import cxxnet_tpu.models as zoo
     from cxxnet_tpu.io.data import DataBatch
@@ -237,7 +260,9 @@ def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
                                         **(builder_kw or {})))
                    + [("eval_train", "0"), ("dtype", dtype),
                       ("grad_dtype", grad_dtype),
-                      ("momentum_dtype", "bfloat16"), ("silent", "1")]
+                      ("momentum_dtype", "bfloat16"), ("silent", "1"),
+                      ("grad_sync", grad_sync),
+                      ("optim_shard", str(int(optim_shard)))]
                    + list(model_cfg) + list(extra))
     t.init_model()
 
@@ -305,6 +330,12 @@ def measure(steps: int = 200, batch: int = None, model: str = "alexnet",
                               for k, v in dict(t.mesh.shape).items()},
                      "process_count": jax.process_count(),
                      "device_count": len(jax.devices())},
+        # sync-tagged capture: gradient reduction mode + ZeRO-1 state
+        # sharding this number was measured under; --compare refuses
+        # an overlap-vs-fused (or sharded-vs-replicated) diff the same
+        # way as dtype/topology (doc/distributed.md)
+        "grad_sync": grad_sync,
+        "optim_shard": int(optim_shard),
     }
     if peak_tflops > 0 and flops_img > 0:
         out["mfu"] = round(ips * flops_img / (peak_tflops * 1e12), 4)
@@ -496,6 +527,25 @@ def main():
                     help="compare img/s across records measured at "
                          "different mesh/process topologies anyway "
                          "(the rows stay topology-annotated)")
+    ap.add_argument("--grad-sync", choices=["fused", "overlap"],
+                    default="fused",
+                    help="gradient reduction mode of the measured step "
+                         "(overlap = per-group boundaries so the "
+                         "cross-host reduce hides under backprop, "
+                         "doc/distributed.md); records are sync-tagged "
+                         "and --compare refuses cross-mode diffs")
+    ap.add_argument("--grad-sync-bucket-mb", type=float, default=0.0,
+                    help="reduction-group bucket size for "
+                         "grad_sync=overlap (0 = one group per layer)")
+    ap.add_argument("--optim-shard", type=int, choices=[0, 1],
+                    default=0,
+                    help="ZeRO-1 optimizer-state sharding across the "
+                         "data axis (doc/updater.md); sync-tagged like "
+                         "--grad-sync")
+    ap.add_argument("--allow-sync-mismatch", action="store_true",
+                    help="compare img/s across records measured under "
+                         "different grad_sync/optim_shard settings "
+                         "anyway (the rows stay sync-annotated)")
     ap.add_argument("--hosts", metavar="H1,H2,..", default=None,
                     help="multi-host dryrun scaling sweep: fake each "
                          "world size over this process's devices and "
@@ -555,7 +605,10 @@ def main():
         sink = MemorySink()
         rec = dryrun_scaling_sweep(
             hosts, rows=args.hosts_rows,
-            global_batch=args.hosts_batch, monitor=Monitor(sink))
+            global_batch=args.hosts_batch, monitor=Monitor(sink),
+            grad_sync=args.grad_sync,
+            grad_sync_bucket_mb=args.grad_sync_bucket_mb,
+            optim_shard=args.optim_shard)
         validate_records(sink.records)
         print(json.dumps(rec))
         if not (rec["loss_parity"] and rec["exactly_once"]
@@ -586,7 +639,9 @@ def main():
         cap = measure(steps=steps, batch=args.batch, model=model,
                       dtype=args.dtype,
                       grad_dtype=args.grad_dtype, extra=extra_cfg,
-                      peak_tflops=args.peak_tflops)
+                      peak_tflops=args.peak_tflops,
+                      grad_sync=args.grad_sync,
+                      optim_shard=args.optim_shard)
         # 'AlexNet' spelling keeps the canonical BENCH metric name
         # stable across rounds
         name = "AlexNet" if model == "alexnet" else model
@@ -602,6 +657,8 @@ def main():
             "zero_recompiles": cap["zero_recompiles"],
             "layout": cap["layout"],
             "dtype": cap["dtype"],
+            "grad_sync": cap["grad_sync"],
+            "optim_shard": cap["optim_shard"],
         }
         if "mfu" in cap:
             rec["mfu"] = cap["mfu"]
@@ -642,13 +699,25 @@ def main():
                 "--allow-topology-mismatch to diff anyway"
                 % ", ".join("%s was %r, this sweep is %r" % mt
                             for mt in tmism))
+        # and for the gradient-sync mode / ZeRO-1 state sharding: an
+        # overlap record must never silently diff against a fused
+        # baseline (exit 2, before the sweep)
+        smism = sync_mismatches(old, args.grad_sync, args.optim_shard)
+        if smism and not args.allow_sync_mismatch:
+            ap.error(
+                "cannot compare across grad-sync settings: %s; pass "
+                "--allow-sync-mismatch to diff anyway"
+                % ", ".join("%s %s was %r, this sweep is %r" % ms
+                            for ms in smism))
     import gc
     models = {}
     for m in sorted(MODELS):
         steps = args.steps if args.steps is not None else 200
         models[m] = measure(steps=steps, model=m, dtype=args.dtype,
                             grad_dtype=args.grad_dtype, extra=extra_cfg,
-                            peak_tflops=args.peak_tflops)
+                            peak_tflops=args.peak_tflops,
+                            grad_sync=args.grad_sync,
+                            optim_shard=args.optim_shard)
         gc.collect()                     # free HBM before the next model
     head = models["alexnet"]
     out = {
@@ -658,6 +727,8 @@ def main():
         "vs_baseline": round(head["value"] / BASELINE_IMAGES_PER_SEC, 3),
         "suspect": any(c["suspect"] for c in models.values()),
         "dtype": args.dtype,
+        "grad_sync": args.grad_sync,
+        "optim_shard": args.optim_shard,
         "models": models,
     }
     # input-pipeline telemetry rides in every BENCH record from this

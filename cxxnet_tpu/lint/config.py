@@ -43,6 +43,13 @@ PROGRAM_BUILDERS = {
     "cxxnet_tpu/nnet/quantize.py": (
         "Calibrator._build_amax_program",
     ),
+    # the step_breakdown measurement programs (doc/distributed.md
+    # "Overlapped gradient sync"): a grad-only program and a group-
+    # granular reduce-only program, built once per measurement call by
+    # bench --hosts / the scaling sweep — never on the training path
+    "cxxnet_tpu/parallel/gradsync.py": (
+        "measure_step_breakdown",
+    ),
 }
 
 # -- CXL003: hot-path roots -----------------------------------------------

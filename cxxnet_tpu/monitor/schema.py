@@ -147,6 +147,20 @@ REQUIRED: Dict[str, tuple] = {
     "scaling_point": ("hosts", "local_devices", "global_batch",
                       "examples_per_sec", "data_wait_share",
                       "rows_per_host", "zero_recompiles"),
+    # per-step time/byte split under a grad_sync mode
+    # (parallel/gradsync.py, emitted per scaling-sweep point and by
+    # bench.py --hosts): gradient-program wall, the standalone
+    # group-granular reduce wall, the full dispatched step wall, the
+    # hidden-reduce fraction, and the optimizer-state footprint —
+    # logical (unsharded) vs distinct bytes resident per host (the
+    # ZeRO-1 optim_shard win, ~1/hosts) plus the lr_mult=0 groups
+    # whose state allocation was skipped (doc/distributed.md
+    # "Overlapped gradient sync")
+    "step_breakdown": ("hosts", "grad_sync", "optim_shard", "groups",
+                       "bucket_mb", "backprop_ms", "reduce_ms",
+                       "step_ms", "overlap_ratio", "grad_bytes",
+                       "opt_state_bytes_unsharded",
+                       "opt_state_bytes_per_host", "frozen_groups"),
     # continual train-while-serve (doc/continual.md): the per-layer
     # finetune carry accounting (task=finetune and the loop's
     # bootstrap), one record per generation attempt (the gate
@@ -166,11 +180,13 @@ _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",
                 "instances_per_sec", "queue_ms", "latency_ms",
                 "device_ms", "latency_p50_ms", "latency_p99_ms",
                 "rows_per_sec", "gather_ms", "serialize_ms",
-                "write_ms", "fsync_ms", "quantize_ms")
+                "write_ms", "fsync_ms", "quantize_ms",
+                "backprop_ms", "reduce_ms", "step_ms")
 
 # ratio fields must sit in [0, 1]
 _RATIO_KEYS = ("buffer_reuse_rate", "h2d_overlap_ratio", "fill_rate",
-               "pad_fraction", "agree_rate", "data_wait_share")
+               "pad_fraction", "agree_rate", "data_wait_share",
+               "overlap_ratio")
 
 
 def validate_record(rec: Dict[str, Any]) -> List[str]:

@@ -189,8 +189,18 @@ def write_snapshot(path: str, arrays: Dict[str, np.ndarray],
                           % (d, e))
             fsync_s += time.perf_counter() - tf
         t2 = time.perf_counter()
+    # optimizer-state share of the payload (save_optimizer=1 snapshots
+    # carry opt/<layer>/<tag>/<key> arrays): snapshots always store the
+    # GATHERED global state — a ZeRO-sharded (optim_shard=1) run
+    # allgathers its shards at save and re-shards at load, so the
+    # artifact stays topology-portable (an H=4 emergency snapshot
+    # resumes at H=2 unchanged, doc/updater.md) — which also means
+    # opt_bytes reports the full logical state, not one host's shard
+    opt_bytes = sum(int(a.nbytes) for k, a in arrays.items()
+                    if k.startswith("opt/"))
     return {
         "bytes": len(payload),
+        "opt_bytes": opt_bytes,
         "digest": digest,
         "serialize_ms": (t1 - t0) * 1e3,
         "write_ms": max(0.0, (t2 - t1) * 1e3 - fsync_s * 1e3),
@@ -511,8 +521,9 @@ class CheckpointManager:
         path = self.path_for(counter)
 
         def _commit():
-            stats = {"bytes": 0, "digest": "", "serialize_ms": 0.0,
-                     "write_ms": 0.0, "fsync_ms": 0.0}
+            stats = {"bytes": 0, "opt_bytes": 0, "digest": "",
+                     "serialize_ms": 0.0, "write_ms": 0.0,
+                     "fsync_ms": 0.0}
             status, err = "ok", ""
             try:
                 stats = write_snapshot(path, arrays, meta,

@@ -96,6 +96,18 @@ class NetTrainer:
         self.silent = 0
         self.model_parallel_min = 0      # 0 = no model-parallel sharding
         self.shard_optimizer = 0         # ZeRO-1 (update_on_server analogue)
+        self.grad_sync = "fused"         # overlap: per-group gradient
+        #                                  reduction boundaries so
+        #                                  cross-host sync overlaps the
+        #                                  remaining backprop
+        #                                  (parallel/gradsync.py); bit-
+        #                                  identical to fused by
+        #                                  construction
+        self.grad_sync_bucket_mb = 0.0   # 0: one reduction group per
+        #                                  layer; >0: greedy size
+        #                                  buckets of at least this
+        #                                  many MB (reverse-layer order
+        #                                  either way)
         self.grad_dtype = "float32"      # bfloat16: bf16 cotangents +
         #                                  bf16 grad all-reduce, f32
         #                                  master weights in the updater
@@ -249,11 +261,21 @@ class NetTrainer:
                     raise ValueError(
                         "dist_topology_check must be off|warn|strict")
                 self.dist_topology_check = val
-            if name in ("shard_optimizer", "update_on_server"):
+            if name in ("shard_optimizer", "update_on_server",
+                        "optim_shard"):
                 # update_on_server=1 meant "optimizer state lives off the
                 # workers" (nnet_ps_server.cpp); here it means "optimizer
-                # state is ZeRO-sharded across the data axis"
+                # state is ZeRO-sharded across the data axis".
+                # optim_shard is the ZeRO-1 spelling (doc/updater.md)
                 self.shard_optimizer = int(val)
+            if name == "grad_sync":
+                if val not in ("fused", "overlap"):
+                    raise ValueError("grad_sync must be fused|overlap")
+                self.grad_sync = val
+            if name == "grad_sync_bucket_mb":
+                self.grad_sync_bucket_mb = float(val)
+                if self.grad_sync_bucket_mb < 0:
+                    raise ValueError("grad_sync_bucket_mb must be >= 0")
             m = _RE_METRIC.match(name)
             if m:
                 spec = m.group(1)
@@ -285,9 +307,11 @@ class NetTrainer:
         g = self.graph
         # one updater per (param layer, tag)
         self.updaters: Dict[str, Dict[str, Any]] = {}
+        self._layer_index: Dict[str, int] = {}
         for lkey, ptree in self.params.items():
             li = g.layer_index(lkey) if lkey in g.layer_name_map \
                 else int(lkey[5:])
+            self._layer_index[lkey] = li
             self.updaters[lkey] = {}
             for tag in ptree:
                 self.updaters[lkey][tag] = create_updater(
@@ -407,6 +431,13 @@ class NetTrainer:
             for lk, ptree in params.items():
                 new_p[lk], new_o[lk] = {}, {}
                 for tag, w in ptree.items():
+                    if not opt_state[lk][tag]:
+                        # frozen group (lr_mult = 0): state allocation
+                        # was skipped, the weight passes through
+                        # untouched — bit-exact vs the pinned freeze
+                        new_p[lk][tag] = w
+                        new_o[lk][tag] = {}
+                        continue
                     upd = self.updaters[lk][tag]
                     g = grads[lk][tag]
                     if update_period > 1:
@@ -490,6 +521,28 @@ class NetTrainer:
             return jax.checkpoint(fn, prevent_cse=barrier, policy=policy)
 
         loss_fn = _wrap_loss_fn()
+        # grad_sync = overlap: thread each reduction group's params
+        # through an identity custom-vjp boundary INSIDE the
+        # differentiated loss. The backward barriers make each group's
+        # gradients (and the SPMD all-reduce that consumes them) an
+        # atomic schedulable unit, so XLA issues group g's cross-host
+        # reduction as soon as g's backward finishes — overlapping DCN
+        # traffic with the remaining (earlier-layer) backprop. Identity
+        # numerics: bit parity with fused is by construction (pinned in
+        # tests/test_gradsync.py at H=2,4).
+        self._sync_groups = None
+        if self.grad_sync == "overlap":
+            from ..parallel import gradsync as _gradsync
+            self._sync_groups = _gradsync.partition_groups(
+                self.params, self._layer_index,
+                bucket_mb=self.grad_sync_bucket_mb)
+            _fused_loss = loss_fn
+            _groups = self._sync_groups
+
+            def loss_fn(p, s, d, l, m, e, r):
+                return _fused_loss(
+                    _gradsync.apply_group_boundaries(p, _groups),
+                    s, d, l, m, e, r)
 
         def scan_step(params, opt_state, net_state, grad_acc,
                       data, labels, mask, extra, hyper_row, epoch,

@@ -69,13 +69,22 @@ def dryrun_scaling_sweep(host_counts: Sequence[int], rows: int = 512,
                          features: int = 64, classes: int = 8,
                          global_batch: int = 64, rounds: int = 2,
                          monitor=None,
-                         workdir: Optional[str] = None
+                         workdir: Optional[str] = None,
+                         grad_sync: str = "fused",
+                         grad_sync_bucket_mb: float = 0.0,
+                         optim_shard: int = 0
                          ) -> Dict[str, Any]:
     """Measure the dryrun input-sharding path at each world size in
     ``host_counts`` (each must divide the device count and the global
     batch). Emits one schema-validated ``scaling_point`` record per
     world size on ``monitor`` (when enabled) and returns the
-    MULTICHIP-style record dict."""
+    MULTICHIP-style record dict. ``grad_sync`` / ``optim_shard`` run
+    the sweep trainer under the overlapped-reduction and ZeRO-1 knobs
+    (doc/distributed.md, doc/updater.md); each point then carries a
+    ``step_breakdown`` sub-record (also emitted on ``monitor``) with
+    the backprop/reduce/step walls, the hidden-reduce overlap ratio,
+    and the per-host optimizer-state bytes."""
+    from . import gradsync
     from ..monitor import MemorySink, Monitor
     from ..monitor.schema import validate_records
     from ..nnet.trainer import NetTrainer
@@ -109,16 +118,21 @@ def dryrun_scaling_sweep(host_counts: Sequence[int], rows: int = 512,
                                      global_batch)
             feed.init()
             sink = MemorySink()
-            t = NetTrainer(parse_config(conf))
+            t = NetTrainer(parse_config(conf) + [
+                ("grad_sync", grad_sync),
+                ("grad_sync_bucket_mb", str(grad_sync_bucket_mb)),
+                ("optim_shard", str(int(optim_shard)))])
             t.init_model()
             t.set_monitor(Monitor(sink))
             t.precompile(window=1)
+            last_batch = None
             for r in range(rounds):
                 t.start_round(r)
                 t_wait = _time.perf_counter()
                 for batch in feed:
                     t.note_data_wait(_time.perf_counter() - t_wait)
                     t.update(batch)
+                    last_batch = batch
                     t_wait = _time.perf_counter()
                 t.end_round()
             validate_records(sink.records)
@@ -142,9 +156,15 @@ def dryrun_scaling_sweep(host_counts: Sequence[int], rows: int = 512,
                                            for r in steps),
             }
             losses.append(float(t.last_loss))
+            # breakdown AFTER the loss capture: the measurement drives
+            # real update dispatches (documented in gradsync), so the
+            # parity loss above must be read first
+            bd = gradsync.measure_step_breakdown(t, last_batch)
+            point["step_breakdown"] = bd
             points.append(point)
             if monitor is not None and monitor.enabled:
                 monitor.emit("scaling_point", **point)
+                monitor.emit("step_breakdown", **bd)
         finally:
             if feed is not None:
                 feed.close()
@@ -172,6 +192,15 @@ def dryrun_scaling_sweep(host_counts: Sequence[int], rows: int = 512,
                    "process with zero DCN traffic, so this curve "
                    "measures shard math and per-host input cost, "
                    "never interconnect (doc/distributed.md)",
+        "grad_sync": grad_sync,
+        "grad_sync_bucket_mb": float(grad_sync_bucket_mb),
+        "optim_shard": int(optim_shard),
+        "breakdown_caveat":
+            "step_breakdown walls come from the same dryrun: its "
+            "collectives are shared-memory copies, not DCN, so "
+            "overlap_ratio bounds the schedule shape only — device "
+            "timings pending a window (doc/distributed.md "
+            "'Overlapped gradient sync'). Byte columns are exact.",
     }
     if own_dir:
         try:

@@ -61,6 +61,8 @@ class SGDUpdater:
         self.param = param
 
     def init_state(self, w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        if self.param.frozen:
+            return {}           # lr_mult=0: no momentum, no state bytes
         return {"m_w": _momentum_zeros(w, self.param)}
 
     def apply(self, w, g, state, hyper):
@@ -79,6 +81,8 @@ class NAGUpdater:
         self.param = param
 
     def init_state(self, w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        if self.param.frozen:
+            return {}           # lr_mult=0: no momentum, no state bytes
         return {"m_w": _momentum_zeros(w, self.param)}
 
     def apply(self, w, g, state, hyper):

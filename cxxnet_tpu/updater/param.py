@@ -64,6 +64,17 @@ class UpdaterParam:
     # and stays f32 regardless)
     momentum_dtype: str = "float32"
 
+    @property
+    def frozen(self) -> bool:
+        """``lr_mult = 0`` pins the group's weights bit-exactly, so a
+        momentum buffer is dead HBM: sgd/nag skip the allocation
+        entirely and the trainer passes the weight through untouched
+        (the skip shows up in the ``step_breakdown`` optimizer-state
+        bytes, doc/updater.md). Adam's schedule ignores lr_mult (its LR
+        derives from base_lr inside the update rule), so the skip
+        applies only to the schedule-driven momentum updaters."""
+        return self.lr_mult == 0.0
+
     def schedule_epoch(self, epoch: int) -> None:
         if self.lr_schedule == 0:
             lr = self.base_lr

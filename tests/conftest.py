@@ -39,6 +39,14 @@ needs_reference = pytest.mark.skipif(
     % REFERENCE_DIR)
 
 
+def pytest_configure(config):
+    # tier-1 runs -m 'not slow' (ROADMAP verify line): anything over
+    # the budget — e.g. the H=4 dryrun overlap sweep — marks itself
+    # slow and runs in the full suite only
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 budgeted run")
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)
