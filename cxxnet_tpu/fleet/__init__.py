@@ -19,20 +19,40 @@ service:
   crashed replicas;
 - :mod:`~cxxnet_tpu.fleet.canary` — one-shot canary rollout: pin a
   fraction, compare per-version windows, promote or roll back with a
-  schema-validated decision record.
+  schema-validated decision record;
+- :mod:`~cxxnet_tpu.fleet.placement` — where processes run: the
+  ``Launcher`` seam behind the spawn path (local Popen today, ssh
+  with the same CLI + port-file contract tomorrow) and the
+  endpoint-registry file that generalizes per-replica port files
+  (doc/serving.md "Sharded front tier");
+- :mod:`~cxxnet_tpu.fleet.quota_shares` — distributed tenant quotas:
+  the fleet rate decomposed into per-door budget shares, rebalanced
+  toward observed demand over gossip.
 """
 
 from .balancer import (FleetBalancer, ReplicaChannel, ReplicaState,
                        ReplicaUnreachable, ReplicaV1Only)
 from .canary import CanaryRollout, canary_decision
 from .config import FleetTierConfig, models_spec, version_of
-from .controller import FleetController, classify_load
+from .controller import (FleetController, aggregate_windows,
+                         classify_load)
+from .placement import (BalancerManager, BalancerProcess,
+                        EndpointRegistry, Launcher, LocalLauncher,
+                        PlacementError, SshLauncher, endpoint_entry,
+                        make_launcher, sync_from_registry,
+                        write_endpoint_file)
+from .quota_shares import QuotaShareManager, compute_shares
 from .replica import ReplicaManager, ReplicaProcess, SpawnError
 
 __all__ = [
     "FleetBalancer", "ReplicaChannel", "ReplicaState",
     "ReplicaUnreachable", "ReplicaV1Only",
     "CanaryRollout", "canary_decision", "FleetTierConfig",
-    "models_spec", "version_of", "FleetController", "classify_load",
+    "models_spec", "version_of", "FleetController",
+    "aggregate_windows", "classify_load",
+    "BalancerManager", "BalancerProcess", "EndpointRegistry",
+    "Launcher", "LocalLauncher", "PlacementError", "SshLauncher",
+    "endpoint_entry", "make_launcher", "sync_from_registry",
+    "write_endpoint_file", "QuotaShareManager", "compute_shares",
     "ReplicaManager", "ReplicaProcess", "SpawnError",
 ]

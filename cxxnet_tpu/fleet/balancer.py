@@ -69,8 +69,9 @@ from ..serve.frontend import (_BinaryHandler, _FleetBinaryServer,
                               _REQ_HEADER_V2, BIN_MAGIC_V2,
                               HTTP_STATUS, BinaryClient, pack_ping_v2,
                               read_reply_tagged)
-from ..serve.quota import QuotaManager, TenantQuotaError
+from ..serve.quota import TenantQuotaError
 from .config import FleetTierConfig
+from .quota_shares import QuotaShareManager
 
 
 class ReplicaUnreachable(IOError):
@@ -130,6 +131,11 @@ class ReplicaChannel:
         self.index = index
         self._sock = socket.create_connection(
             (host, port), timeout=connect_timeout)
+        # frames go out as header + body segments: without NODELAY,
+        # Nagle holds the body for the replica's delayed ACK (~40ms
+        # added to EVERY channel exchange)
+        self._sock.setsockopt(socket.IPPROTO_TCP,
+                              socket.TCP_NODELAY, 1)
         self._rfile = self._sock.makefile("rb")
         self._lock = threading.Lock()
         self._inflight: Dict[int, _Inflight] = {}
@@ -323,6 +329,11 @@ class ReplicaState:
         self.fail_polls = 0
         self.inflight = 0
         self.health: Dict[str, Any] = {}
+        # freshness + provenance of ``health``: a multi-balancer tier
+        # partitions polling, so state may arrive from a peer's gossip
+        # view instead of a direct poll
+        self.health_ts = 0.0
+        self.health_src = ""
         self.v1_only = False
         self._pool: List[BinaryClient] = []
         self._pool_lock = threading.Lock()
@@ -632,7 +643,14 @@ class FleetBalancer:
 
     def __init__(self, tier: FleetTierConfig, cfg=(), monitor=None):
         self.tier = tier
-        self.quota = QuotaManager(cfg)
+        self.balancer_id = tier.balancer_id
+        self.balancer_index = tier.balancer_index
+        # a share manager even at balancers=1: the single-door case is
+        # bit-identical to the plain QuotaManager (pinned by test), so
+        # every existing quota contract exercises the shared code path
+        self.quota = QuotaShareManager(cfg,
+                                       balancer_id=tier.balancer_id,
+                                       balancers=tier.balancers)
         self._mon = monitor
         self._safe_emit = SafeEmitter(monitor, "cxxnet_tpu fleet")
         self._lock = threading.Lock()        # replica table
@@ -651,6 +669,11 @@ class FleetBalancer:
         self._pin_fraction = 0.0
         self._pick_seq = 0
         self._pick_rr = 0
+        self._inflight_reqs = 0
+        # intra-tier state: peer doors (balancer_id, host, http_port)
+        # and their last gossip views (demand rates for rebalancing)
+        self._peers: List[Tuple[str, str, int]] = []
+        self._peer_views: Dict[str, Dict[str, Any]] = {}
         self._closing = False
         self._coal: Optional[_Coalescer] = None
         if tier.coalesce_ms > 0:
@@ -682,6 +705,43 @@ class FleetBalancer:
             rep = self._reps.pop(replica_id, None)
         if rep is not None:
             rep.close_pool()
+
+    def has_replica(self, replica_id: str) -> bool:
+        with self._lock:
+            return replica_id in self._reps
+
+    def set_replica_draining(self, replica_id: str,
+                             draining: bool) -> bool:
+        """Flip the draining flag (registry-driven; an external door
+        learns drains from the controller's registry writes, not a
+        direct call). Returns True when the flag changed."""
+        with self._lock:
+            rep = self._reps.get(replica_id)
+            if rep is None or rep.draining == bool(draining):
+                return False
+            rep.draining = bool(draining)
+            return True
+
+    # -- intra-tier peers (sharded front tier) -----------------------------
+
+    def set_tier_peers(self, peers: List[Tuple[str, str, int]]) -> bool:
+        """The OTHER doors of this tier as ``(balancer_id, host,
+        http_port)`` — gossip partners and the divisor of the poll
+        partition. Returns True when the set changed."""
+        peers = sorted(peers)
+        with self._lock:
+            if peers == self._peers:
+                return False
+            self._peers = peers
+            live = {p[0] for p in peers}
+            for bid in list(self._peer_views):
+                if bid not in live:
+                    del self._peer_views[bid]
+            return True
+
+    def tier_peers(self) -> List[Tuple[str, str, int]]:
+        with self._lock:
+            return list(self._peers)
 
     def drain_replica(self, replica_id: str,
                       timeout_s: float = 30.0) -> bool:
@@ -769,6 +829,8 @@ class FleetBalancer:
         nrows = 0
         replica_id, version, retries = "", "", 0
         coalesced, channel = 1, -1
+        with self._stats:
+            self._inflight_reqs += 1
         try:
             if isinstance(rows, np.ndarray) \
                     and rows.dtype == np.dtype("<f4") \
@@ -788,6 +850,7 @@ class FleetBalancer:
                 self._emit("tenant_shed", tenant=tenant,
                            model=model_id, rows=nrows, rate=e.rate,
                            burst=e.burst,
+                           balancer=self.balancer_id,
                            retry_after_s=round(e.retry_after_s, 3))
                 raise
             if self._coal is not None:
@@ -816,6 +879,9 @@ class FleetBalancer:
             status, result, extra = "bad_request", str(e), {}
         except Exception as e:   # a balancer bug must answer, not hang
             status, result, extra = "error", str(e), {}
+        finally:
+            with self._stats:
+                self._inflight_reqs -= 1
         self._record(protocol, status, model_id, tenant, nrows,
                      replica_id, version, retries, t0,
                      coalesced=coalesced, channel=channel)
@@ -1132,7 +1198,7 @@ class FleetBalancer:
         self._emit("fleet_batch", model=model_id,
                    replica=rep.replica_id, status=status,
                    requests=len(jobs), rows=nrows, channel=channel,
-                   retries=retries,
+                   retries=retries, balancer=self.balancer_id,
                    latency_ms=(time.monotonic() - t_fwd) * 1e3)
         self._resolve_merged(jobs, status, result, {},
                              rep.replica_id, rep.version, retries,
@@ -1209,7 +1275,8 @@ class FleetBalancer:
                    model=model, tenant=tenant, rows=rows,
                    replica=replica_id, version=version,
                    retries=retries, latency_ms=latency_s * 1e3,
-                   coalesced=coalesced, channel=channel)
+                   coalesced=coalesced, channel=channel,
+                   balancer=self.balancer_id)
 
     def take_window(self) -> Dict[str, Any]:
         """Counters since the last call plus the CURRENT fleet load —
@@ -1271,6 +1338,8 @@ class FleetBalancer:
         with self._lock:
             if ok:
                 rep.health = payload
+                rep.health_ts = time.monotonic()
+                rep.health_src = "poll"
                 rep.fail_polls = 0
                 rep.suspect = False
                 rep.suspect_since = 0.0
@@ -1281,18 +1350,143 @@ class FleetBalancer:
                     rep.suspect = True
                     rep.suspect_since = time.monotonic()
 
+    def _poll_targets(self) -> List[ReplicaState]:
+        """The replicas THIS door polls: with N doors, replica i (in
+        sorted id order) belongs to door ``i % N`` — tier health costs
+        one poll per replica per period, not N. A replica whose state
+        has gone stale (its owner door died, or gossip is broken)
+        falls back to a direct poll from everyone: correctness first,
+        amplification second."""
+        with self._lock:
+            reps = sorted(self._reps.values(),
+                          key=lambda r: r.replica_id)
+            npeers = len(self._peers)
+        if not npeers:
+            return reps
+        n = npeers + 1
+        stale_after = max(2 * self.tier.gossip_s,
+                          4 * self.tier.health_poll_s)
+        now = time.monotonic()
+        return [rep for i, rep in enumerate(reps)
+                if i % n == self.balancer_index % n
+                or now - rep.health_ts > stale_after]
+
     def _poll_loop(self) -> None:
         while not self._poll_stop.wait(self.tier.health_poll_s):
-            with self._lock:
-                reps = list(self._reps.values())
-            for rep in reps:
+            for rep in self._poll_targets():
                 self._poll_once(rep)
 
+    # -- intra-tier gossip (sharded front tier) ----------------------------
+
+    def view_snapshot(self) -> Dict[str, Any]:
+        """``GET /fleet/view``: what this door KNOWS first-hand — the
+        health of the replicas it polled itself (``age_s`` relative,
+        monotonic clocks don't compare across processes) plus its own
+        demand rates. Gossip-learned state is excluded so a view never
+        echoes another door's data back as fresh."""
+        now = time.monotonic()
+        reps: Dict[str, Any] = {}
+        with self._lock:
+            for r in self._reps.values():
+                if r.health_src != "poll" or not r.health_ts:
+                    continue
+                reps[r.replica_id] = {
+                    "health": r.health, "suspect": r.suspect,
+                    "age_s": round(now - r.health_ts, 3)}
+        return {"balancer": self.balancer_id,
+                "index": self.balancer_index,
+                "replicas": reps,
+                "demand": self.quota.demand_view(),
+                "inflight": self._inflight_snapshot()}
+
+    def merge_view(self, view: Dict[str, Any]) -> None:
+        """Fold one peer's ``/fleet/view`` into the local tables:
+        newer replica health wins (by age), and the peer's demand
+        rates feed the next quota rebalance."""
+        bid = str(view.get("balancer", ""))
+        if not bid:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._peer_views[bid] = {
+                "ts": now,
+                "demand": {str(t): float(r) for t, r in
+                           dict(view.get("demand", {})).items()}}
+            for rid, info in dict(view.get("replicas", {})).items():
+                rep = self._reps.get(rid)
+                if rep is None:
+                    continue
+                ts = now - float(info.get("age_s", 0.0))
+                if ts <= rep.health_ts:
+                    continue          # our own information is newer
+                health = info.get("health")
+                if health:
+                    rep.health = dict(health)
+                rep.health_ts = ts
+                rep.health_src = "gossip"
+                suspect = bool(info.get("suspect", False))
+                if suspect and not rep.suspect:
+                    rep.suspect = True
+                    rep.suspect_since = now
+                elif not suspect and rep.suspect:
+                    rep.suspect = False
+                    rep.suspect_since = 0.0
+                    rep.fail_polls = 0
+
+    def _fetch_peer_view(self, host: str, port: int
+                         ) -> Optional[Dict[str, Any]]:
+        try:
+            conn = http.client.HTTPConnection(
+                host, port, timeout=max(1.0, self.tier.gossip_s * 4))
+            try:
+                conn.request("GET", "/fleet/view")
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    return None
+                return json.loads(resp.read())
+            finally:
+                conn.close()
+        except (OSError, ValueError):
+            return None
+
+    def _gossip_loop(self) -> None:
+        next_rebalance = time.monotonic() \
+            + self.tier.quota_rebalance_s
+        while not self._poll_stop.wait(self.tier.gossip_s):
+            for bid, host, port in self.tier_peers():
+                view = self._fetch_peer_view(host, port)
+                if view is not None:
+                    self.merge_view(view)
+            if time.monotonic() >= next_rebalance:
+                self._rebalance_quota()
+                next_rebalance = time.monotonic() \
+                    + self.tier.quota_rebalance_s
+
+    def _rebalance_quota(self) -> None:
+        """Close this door's demand window and recompute its share
+        fractions from the merged per-door demand views."""
+        views = {self.balancer_id: self.quota.sample_demand()}
+        with self._lock:
+            for bid, pv in self._peer_views.items():
+                views[bid] = dict(pv.get("demand", {}))
+        changed = self.quota.rebalance(views)
+        if changed:
+            self._emit(
+                "quota_rebalance", balancer=self.balancer_id,
+                tenants=len(changed),
+                window_s=round(self.tier.quota_rebalance_s, 3),
+                shares={t: round(f, 4) for t, f in changed.items()})
+
     # -- own health / status ----------------------------------------------
+
+    def _inflight_snapshot(self) -> int:
+        with self._stats:
+            return self._inflight_reqs
 
     def health_snapshot(self) -> Dict[str, Any]:
         with self._stats:
             c = dict(self.counters)
+            inflight = self._inflight_reqs
         reps = self.describe_replicas()
         ready = sum(1 for r in reps
                     if r["ready"] and not r["draining"]
@@ -1301,11 +1495,22 @@ class FleetBalancer:
             pin = {"version": self._pin_version,
                    "fraction": self._pin_fraction} \
                 if self._pin_version else None
+            npeers = len(self._peers)
+            rep_states = list(self._reps.values())
+        chan_depth = sum(r.channel_depth() for r in rep_states)
         return {"ok": ready > 0, "tier": "balancer",
+                "balancer": self.balancer_id,
+                "balancers": npeers + 1,
                 "ready": ready, "replicas": reps,
                 "requests": c["requests"], "shed": c["shed"],
                 "errors": c["errors"], "retries": c["retries"],
                 "canary": pin,
+                # self-report: this door's OWN load, uniform with the
+                # replica tier's /healthz so serve_bench and the
+                # controller read both tiers the same way
+                "inflight": inflight,
+                "channel_depth": chan_depth,
+                "quota_shares": self.quota.share_snapshot(),
                 "queue_rows": sum(r["queue_rows"] for r in reps),
                 "resident_bytes": sum(r["resident_bytes"]
                                       for r in reps)}
@@ -1364,6 +1569,12 @@ class FleetBalancer:
                                   name="fleet-health", daemon=True)
         poller.start()
         self._threads.append(poller)
+        if t.balancers > 1:
+            gossiper = threading.Thread(target=self._gossip_loop,
+                                        name="fleet-gossip",
+                                        daemon=True)
+            gossiper.start()
+            self._threads.append(gossiper)
 
     def close(self) -> Dict[str, Any]:
         self._closing = True
@@ -1401,6 +1612,14 @@ class _BalancerHttpHandler(_HttpHandler):
             self._send_json(200, bal.health_snapshot())
         elif self.path == "/v1/models":
             self._send_json(200, bal.models_snapshot())
+        elif self.path == "/fleet/view":
+            # intra-tier gossip: peers fetch this door's first-hand
+            # replica health + demand rates (non-destructive)
+            self._send_json(200, bal.view_snapshot())
+        elif self.path == "/fleet/window":
+            # DESTRUCTIVE window read for the controller's autoscale
+            # aggregation — one caller per door, by contract
+            self._send_json(200, bal.take_window())
         else:
             self._send_json(404, {"error": "not_found",
                                   "message": "unknown path %r"

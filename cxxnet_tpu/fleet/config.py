@@ -81,9 +81,37 @@ class FleetTierConfig:
         self.canary_max_error_rate = 0.02
         self.canary_p99_ratio = 1.5
         self.canary_out = ""
+        self.balancers = 1
+        self.balancer_id = ""
+        self.balancer_index = 0
+        self.gossip_s = 0.5
+        self.quota_rebalance_s = 2.0
+        self.launcher = "local"
+        self.hosts: List[str] = []
+        self.registry = ""
+        self.port_file = ""
         models_val = ""
         model_dir, model_in = "", ""
         for name, val in cfg:
+            if name == "fleet_balancers":
+                self.balancers = int(val)
+            if name == "fleet_balancer_id":
+                self.balancer_id = val
+            if name == "fleet_balancer_index":
+                self.balancer_index = int(val)
+            if name == "fleet_gossip_s":
+                self.gossip_s = float(val)
+            if name == "fleet_quota_rebalance_s":
+                self.quota_rebalance_s = float(val)
+            if name == "fleet_launcher":
+                self.launcher = val
+            if name == "fleet_hosts":
+                self.hosts = [h.strip() for h in val.split(",")
+                              if h.strip()]
+            if name == "fleet_registry":
+                self.registry = val
+            if name == "fleet_port_file":
+                self.port_file = val
             if name == "fleet_replicas":
                 self.replicas = int(val)
             if name == "fleet_min_replicas":
@@ -179,6 +207,32 @@ class FleetTierConfig:
             raise ValueError(
                 "canary_fraction must be in (0, 1), got %r"
                 % self.canary_fraction)
+        if self.balancers < 1:
+            raise ValueError("fleet_balancers must be >= 1")
+        if not 0 <= self.balancer_index < self.balancers:
+            raise ValueError(
+                "fleet_balancer_index must be in [0, %d), got %d"
+                % (self.balancers, self.balancer_index))
+        if not self.balancer_id:
+            self.balancer_id = "b%d" % self.balancer_index
+        if self.gossip_s <= 0:
+            raise ValueError("fleet_gossip_s must be > 0")
+        if self.quota_rebalance_s <= 0:
+            raise ValueError("fleet_quota_rebalance_s must be > 0")
+        if self.launcher not in ("local", "ssh"):
+            raise ValueError(
+                "fleet_launcher must be local or ssh, got %r"
+                % self.launcher)
+        if self.launcher == "ssh" and not self.hosts:
+            raise ValueError("fleet_launcher=ssh needs fleet_hosts")
+        if self.balancers > 1 and self.canary_source:
+            # canary pinning routes a deterministic request fraction
+            # through ONE door's rollout state; a sharded front tier
+            # would need tier-wide canary accounting, which is out of
+            # scope for now
+            raise ValueError(
+                "canary_source requires fleet_balancers=1 (canary "
+                "accounting is single-door)")
         if self.http_port < 0 and self.binary_port < 0:
             raise ValueError(
                 "fleet balancer with both protocols disabled serves "
@@ -206,6 +260,13 @@ class FleetTierConfig:
                 "canary_model %r is not a served model id (%s)"
                 % (self.canary_model,
                    ", ".join(m for m, _, _ in self.models)))
+
+    @property
+    def registry_path(self) -> str:
+        """The endpoint-registry file this fleet shares (explicit
+        ``fleet_registry`` or ``<fleet_dir>/endpoints.json``)."""
+        return self.registry or os.path.join(self.fleet_dir,
+                                             "endpoints.json")
 
     def models_with_source(self, source: str) -> List[ModelEntry]:
         """The model set with the canary-target model's source replaced

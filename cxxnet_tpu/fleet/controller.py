@@ -28,14 +28,18 @@ Every action emits a schema-validated ``fleet_scale`` record.
 
 from __future__ import annotations
 
+import http.client
+import json
 import threading
 import time
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..monitor import SafeEmitter
 from .balancer import FleetBalancer
 from .canary import CanaryRollout
 from .config import FleetTierConfig
+from .placement import (BalancerManager, EndpointRegistry,
+                        endpoint_entry)
 from .replica import ReplicaManager, ReplicaProcess, SpawnError
 
 
@@ -81,6 +85,38 @@ def classify_load(stats: Dict[str, Any],
     return "steady", "within thresholds"
 
 
+def aggregate_windows(windows: Sequence[Dict[str, Any]]
+                      ) -> Dict[str, Any]:
+    """Fold per-door balancer windows into one fleet window for
+    :func:`classify_load`. Traffic counters are disjoint per door and
+    SUM; replica-state gauges (queued rows, ready count, dispatch
+    capacity) are each door's view of the SAME replicas and take the
+    max (summing would multiply the fleet's queue by N doors); p99 is
+    the worst door (conservative for the SLO rule); coalesce fill is
+    forward-weighted."""
+    agg: Dict[str, Any] = {
+        "requests": 0, "ok": 0, "shed": 0, "errors": 0,
+        "p99_ms": 0.0, "queue_rows": 0, "max_batch": 0, "ready": 0,
+        "replicas": 0, "window_s": 0.0, "channel_depth": 0,
+        "forwards": 0, "coalesce_fill": 0.0,
+        "balancers": len(windows)}
+    fill_weighted = 0.0
+    for w in windows:
+        for k in ("requests", "ok", "shed", "errors", "forwards",
+                  "channel_depth"):
+            agg[k] += int(w.get(k, 0))
+        for k in ("queue_rows", "max_batch", "ready", "replicas"):
+            agg[k] = max(agg[k], int(w.get(k, 0)))
+        for k in ("p99_ms", "window_s"):
+            agg[k] = max(agg[k], float(w.get(k, 0.0)))
+        fill_weighted += float(w.get("coalesce_fill", 0.0)) \
+            * int(w.get("forwards", 0))
+    if agg["forwards"]:
+        agg["coalesce_fill"] = round(
+            fill_weighted / agg["forwards"], 3)
+    return agg
+
+
 class FleetController:
     """Owns balancer + replica manager + optional canary; the
     ``task = fleet`` body builds exactly one of these.
@@ -92,7 +128,8 @@ class FleetController:
 
     def __init__(self, cfg: Sequence, conf_path: str = "",
                  monitor=None, manager=None,
-                 extra_overrides: Sequence[str] = ()):
+                 extra_overrides: Sequence[str] = (),
+                 bal_manager=None):
         self.cfg = list(cfg)
         self.tier = FleetTierConfig(self.cfg)
         self._mon = monitor
@@ -103,6 +140,23 @@ class FleetController:
         self.manager = manager if manager is not None else \
             ReplicaManager(conf_path, self.tier,
                            extra_overrides=extra_overrides)
+        # sharded front tier (fleet_balancers > 1): this process keeps
+        # door b0 in-process (canary/window reads stay direct) and
+        # spawns doors b1..bN-1 through the placement layer; discovery
+        # for doors and clients is the endpoint-registry file. Like
+        # ``manager``, ``bal_manager`` is injectable for tests.
+        self.registry: Optional[EndpointRegistry] = None
+        self.bal_manager = None
+        if self.tier.balancers > 1 or self.tier.registry:
+            self.registry = EndpointRegistry(self.tier.registry_path)
+            self.registry.write([])
+        if self.tier.balancers > 1:
+            self.bal_manager = bal_manager if bal_manager is not None \
+                else BalancerManager(
+                    conf_path, self.tier,
+                    extra_overrides=extra_overrides,
+                    monitor_dir=self.tier.fleet_dir
+                    if monitor is not None else "")
         # the model set newly spawned baseline replicas serve; a
         # canary promote repoints this at the new version
         self._lock = threading.Lock()
@@ -144,9 +198,15 @@ class FleetController:
         rep = self.manager.spawn(models, version, kind=kind)
         with self._lock:
             self._reps[rep.replica_id] = rep
-        self.balancer.add_replica(rep.replica_id, "127.0.0.1",
+        host = getattr(rep, "host", "127.0.0.1")
+        self.balancer.add_replica(rep.replica_id, host,
                                   rep.http_port, rep.binary_port,
                                   version, kind=kind)
+        if self.registry is not None:
+            self.registry.upsert(endpoint_entry(
+                rep.replica_id, "replica", host, rep.http_port,
+                rep.binary_port, version=version, kind=kind,
+                pid=rep.pid))
         self._emit_scale("replica_ready",
                          "replica %s (pid %d) serving %s"
                          % (rep.replica_id, rep.pid, version))
@@ -157,8 +217,16 @@ class FleetController:
         """Zero-drop scale-in: deroute, wait for in-flight forwards,
         then graceful-stop the process (its serve_fleet loop drains
         its own queues on SIGTERM)."""
+        if self.registry is not None:
+            # external doors learn the drain from the registry before
+            # the process goes away — same zero-drop order, tier-wide
+            self.registry.set_draining(rep.replica_id, True)
         drained = self.balancer.drain_replica(rep.replica_id)
+        drained = self._await_external_drain(rep.replica_id) \
+            and drained
         self.balancer.remove_replica(rep.replica_id)
+        if self.registry is not None:
+            self.registry.remove(rep.replica_id)
         self.manager.stop(rep)
         with self._lock:
             self._reps.pop(rep.replica_id, None)
@@ -172,17 +240,107 @@ class FleetController:
         self._safe_emit(kind, **fields)
 
     def _emit_scale(self, action: str, reason: str, **fields) -> None:
+        if self.bal_manager is not None:
+            fields.setdefault(
+                "balancers", 1 + len(self.bal_manager.balancers()))
         self._emit("fleet_scale", action=action,
                    replicas=len(self.manager.replicas()),
                    ready=self.ready_count(), reason=reason,
                    **fields)
 
+    # -- sharded front tier (fleet_balancers > 1) --------------------------
+
+    def _register_door0(self) -> None:
+        if self.registry is not None:
+            self.registry.upsert(endpoint_entry(
+                self.balancer.balancer_id, "balancer", self.tier.host,
+                self.balancer.http_port, self.balancer.binary_port))
+
+    def _sync_door_peers(self) -> None:
+        """Point the in-process door at the external doors (external
+        doors learn their peers from the registry instead)."""
+        if self.bal_manager is None:
+            return
+        self.balancer.set_tier_peers(
+            [(b.balancer_id, b.host, b.http_port)
+             for b in self.bal_manager.balancers()])
+
+    def _spawn_door(self, index: int) -> None:
+        bal = self.bal_manager.spawn(index)
+        if self.registry is not None:
+            self.registry.upsert(endpoint_entry(
+                bal.balancer_id, "balancer", bal.host, bal.http_port,
+                bal.binary_port, pid=bal.pid))
+        self._sync_door_peers()
+        self._emit_scale("balancer_ready",
+                         "balancer %s (pid %d) serving"
+                         % (bal.balancer_id, bal.pid))
+
+    def _fetch_json(self, host: str, port: int,
+                    path: str) -> Optional[Dict[str, Any]]:
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=5.0)
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    return None
+                return json.loads(resp.read())
+            finally:
+                conn.close()
+        except (OSError, ValueError):
+            return None
+
+    def _await_external_drain(self, rid: str,
+                              timeout_s: float = 30.0) -> bool:
+        """Wait until every external door has SEEN the drain (its
+        registry sync applied the flag, or the replica left its table)
+        and has no in-flight forwards to the victim. An unreachable
+        door does not block a retire — its own self-heal handles it."""
+        if self.bal_manager is None:
+            return True
+        deadline = time.monotonic() + timeout_s
+        for bal in self.bal_manager.balancers():
+            while time.monotonic() < deadline:
+                snap = self._fetch_json(bal.host, bal.http_port,
+                                        "/healthz")
+                if snap is None:
+                    break
+                row = next((r for r in snap.get("replicas", [])
+                            if r.get("replica") == rid), None)
+                if row is None or (row.get("draining")
+                                   and not row.get("inflight")):
+                    break
+                time.sleep(0.05)
+            else:
+                return False
+        return True
+
+    def front_doors(self) -> List[Dict[str, Any]]:
+        """Every door of the tier as ``(id, host, http, binary)``
+        descriptors — b0 in-process plus the spawned doors; what the
+        bench and clients iterate for failover endpoints."""
+        doors = [{"id": self.balancer.balancer_id,
+                  "host": self.tier.host,
+                  "http_port": self.balancer.http_port,
+                  "binary_port": self.balancer.binary_port}]
+        if self.bal_manager is not None:
+            doors += [{"id": b.balancer_id, "host": b.host,
+                       "http_port": b.http_port,
+                       "binary_port": b.binary_port}
+                      for b in self.bal_manager.balancers()]
+        return doors
+
     # -- startup / shutdown ------------------------------------------------
 
     def start(self) -> None:
         self.balancer.start()
+        self._register_door0()
         for _ in range(self.tier.replicas):
             self.spawn_replica()                 # SpawnError is fatal here
+        if self.bal_manager is not None:
+            for i in range(1, self.tier.balancers):
+                self._spawn_door(i)              # SpawnError fatal too
         if self.canary is not None:
             self.canary.arm()
         self._scale_thread = threading.Thread(
@@ -193,12 +351,22 @@ class FleetController:
         self._stop.set()
         if self._scale_thread is not None:
             self._scale_thread.join(timeout=60)
+        if self.bal_manager is not None:
+            # doors first: their in-flight forwards drain into the
+            # replicas, which are still up to answer them
+            for bal in self.bal_manager.balancers():
+                if self.registry is not None:
+                    self.registry.remove(bal.balancer_id)
+                self.bal_manager.stop(bal)
+            self.bal_manager.close()
         with self._lock:
             reps = list(self._reps.values())
         for rep in reps:
             self.retire_replica(rep, action="shutdown")
         self.manager.close()
         summary = self.balancer.close()
+        if self.registry is not None:
+            self.registry.remove(self.balancer.balancer_id)
         if self.canary is not None:
             summary["canary"] = self.canary.state
         return summary
@@ -222,7 +390,7 @@ class FleetController:
         if self.canary is not None:
             self.canary.step()
         if stats is None:
-            stats = self.balancer.take_window()
+            stats = self._take_fleet_window()
         state, reason = classify_load(stats, self.tier)
         now = time.monotonic()
         self._overload_since = (self._overload_since or now) \
@@ -253,9 +421,42 @@ class FleetController:
                 if victim is not None:
                     self.retire_replica(victim)
 
+    def _take_fleet_window(self) -> Dict[str, Any]:
+        """The autoscaler's input across the whole front tier: the
+        in-process door's window plus one destructive
+        ``GET /fleet/window`` per external door (this controller is
+        the only window reader, by contract)."""
+        windows = [self.balancer.take_window()]
+        if self.bal_manager is not None:
+            for bal in self.bal_manager.balancers():
+                w = self._fetch_json(bal.host, bal.http_port,
+                                     "/fleet/window")
+                if w is not None:
+                    windows.append(w)
+        if len(windows) == 1:
+            return windows[0]
+        return aggregate_windows(windows)
+
     def _reap_dead(self) -> None:
         """Deroute crashed replicas, reap alive-but-wedged ones, then
         self-heal below the minimum."""
+        if self.bal_manager is not None:
+            # a dead front door loses no requests (clients fail over),
+            # but the tier must heal back to fleet_balancers doors
+            for bal in self.bal_manager.poll_dead():
+                if self.registry is not None:
+                    self.registry.remove(bal.balancer_id)
+                self._sync_door_peers()
+                self._emit_scale(
+                    "balancer_lost",
+                    "balancer %s (pid %d) exited with %s"
+                    % (bal.balancer_id, bal.pid,
+                       bal.proc.returncode))
+                if not self._stop.is_set():
+                    try:
+                        self._spawn_door(bal.index)
+                    except SpawnError as e:
+                        self._emit_scale("spawn_failed", str(e))
         if self.tier.wedged_after_s > 0:
             # a process that is alive but unresponsive (deadlock,
             # swap-death) never shows up in poll_dead — without this

@@ -37,6 +37,7 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from .config import FleetTierConfig, ModelEntry, models_spec
+from .placement import Launcher, LocalLauncher
 
 
 class SpawnError(RuntimeError):
@@ -62,7 +63,8 @@ class ReplicaProcess:
 
     def __init__(self, replica_id: str, proc: subprocess.Popen,
                  models: Sequence[ModelEntry], version: str,
-                 kind: str, port_file: str, log_path: str):
+                 kind: str, port_file: str, log_path: str,
+                 host: str = "127.0.0.1"):
         self.replica_id = replica_id
         self.proc = proc
         self.models = list(models)
@@ -70,6 +72,7 @@ class ReplicaProcess:
         self.kind = kind                     # "baseline" | "canary"
         self.port_file = port_file
         self.log_path = log_path
+        self.host = host
         self.http_port = 0
         self.binary_port = 0
         self.stopped = False                 # stopped BY the manager
@@ -91,12 +94,17 @@ class ReplicaManager:
     """
 
     def __init__(self, conf_path: str, tier: FleetTierConfig,
-                 extra_overrides: Sequence[str] = ()):
+                 extra_overrides: Sequence[str] = (),
+                 launcher: Optional[Launcher] = None):
         self.conf_path = conf_path
         self.tier = tier
         # overrides every replica inherits (e.g. the CLI overrides the
         # operator passed to task=fleet, minus the fleet-only keys)
         self.extra_overrides = list(extra_overrides)
+        # where replica processes run: local Popen by default; the
+        # placement layer (fleet/placement.py) swaps in cross-machine
+        # launchers behind the same CLI + port-file contract
+        self.launcher = launcher or LocalLauncher()
         self._lock = threading.Lock()
         self._replicas: Dict[str, ReplicaProcess] = {}
         self._seq = 0
@@ -117,7 +125,7 @@ class ReplicaManager:
             "serve_models=%s" % models_spec(models),
             "serve_http_port=0",
             "serve_binary_port=0",
-            "serve_host=127.0.0.1",
+            "serve_host=%s" % self.launcher.host(),
             "serve_port_file=%s" % port_file,
             # fleet versioning is controller-driven (canary rollout /
             # promote): the per-replica snapshot watcher must not race
@@ -148,19 +156,11 @@ class ReplicaManager:
         log_path = os.path.join(self.tier.fleet_dir, "%s.log" % rid)
         if os.path.exists(port_file):
             os.remove(port_file)
-        env = dict(os.environ)
-        # the replica must import this checkout's cxxnet_tpu, not
-        # whatever an installed site-packages might shadow
-        pkg_root = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
-        env["PYTHONPATH"] = pkg_root + os.pathsep + \
-            env.get("PYTHONPATH", "")
-        with open(log_path, "ab") as logf:
-            proc = subprocess.Popen(
-                self._command(rid, models, port_file),
-                stdout=logf, stderr=subprocess.STDOUT, env=env)
+        proc = self.launcher.launch(
+            self._command(rid, models, port_file), log_path)
         rep = ReplicaProcess(rid, proc, models, version, kind,
-                             port_file, log_path)
+                             port_file, log_path,
+                             host=self.launcher.host())
         deadline = time.monotonic() + self.tier.spawn_timeout_s
         while time.monotonic() < deadline:
             if proc.poll() is not None:

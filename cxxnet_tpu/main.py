@@ -384,6 +384,12 @@ class LearnTask:
                 # over N replica processes, each a task=serve_fleet
                 # child spawned from this same config file
                 return self._task_fleet(cfg, argv[0], argv[1:])
+            if self.task == "fleet_balancer":
+                # one door of a sharded front tier: a standalone
+                # balancer process learning replicas and peers from
+                # the endpoint registry (spawned by task=fleet when
+                # fleet_balancers > 1, or run standalone)
+                return self._task_fleet_balancer(cfg)
             if self.task == "export":
                 # sealing a snapshot into a bundle needs no data
                 # either — only the net config and the serve contract
@@ -1113,6 +1119,69 @@ class LearnTask:
                     if "canary" in summary else ""))
         if mon.enabled:
             mon.emit("task_end", task="fleet",
+                     requests=summary.get("requests", 0))
+        return 0
+
+    def _task_fleet_balancer(self, cfg) -> int:
+        """One door of the sharded front tier (doc/serving.md
+        "Sharded front tier"): a standalone :class:`FleetBalancer`
+        that publishes its ports through ``fleet_port_file`` and
+        reconciles replicas / tier peers from the shared endpoint
+        registry on every sync tick — the same spawn-through-CLI +
+        port-file discipline replicas use. Runs for
+        ``fleet_duration_s`` seconds (0 = until SIGTERM/SIGINT)."""
+        assert world_size() == 1, \
+            "task=fleet_balancer must run single-process"
+        from .fleet import FleetBalancer, FleetTierConfig
+        from .fleet.placement import (EndpointRegistry,
+                                      sync_from_registry,
+                                      write_endpoint_file)
+        mon = self._mon
+        if mon.enabled:
+            mon.emit("run_start",
+                     **run_metadata("fleet_balancer",
+                                    self._cfg_stream))
+        tier = FleetTierConfig(cfg)
+        bal = FleetBalancer(tier, cfg, monitor=mon)
+        registry = EndpointRegistry(tier.registry_path)
+        handlers = []
+        summary = {}
+        try:
+            bal.start()
+            sync_from_registry(bal, registry, tier.balancer_id)
+            if tier.port_file:
+                write_endpoint_file(
+                    tier.port_file,
+                    {"pid": os.getpid(), "http_port": bal.http_port,
+                     "binary_port": bal.binary_port})
+            mon.line("fleet_balancer: %s http=%s binary=%s, "
+                     "registry %s"
+                     % (tier.balancer_id, bal.http_port,
+                        bal.binary_port, tier.registry_path))
+            handlers = self._install_preempt_handlers()
+            dur = tier.duration_s
+            deadline = time.monotonic() + dur if dur > 0 else None
+            # the sync cadence bounds how fast this door sees a drain
+            # or a new replica — well under the controller's drain
+            # wait, and cheap (an mtime stat when nothing changed)
+            sync_s = min(0.2, tier.gossip_s)
+            while self._preempt_signum is None:
+                if deadline is not None \
+                        and time.monotonic() >= deadline:
+                    break
+                sync_from_registry(bal, registry, tier.balancer_id)
+                time.sleep(sync_s)
+        finally:
+            summary = bal.close()
+            self._restore_handlers(handlers)
+        mon.line("fleet_balancer: %s served %d requests (%d ok / "
+                 "%d shed / %d error, %d retries recovered)"
+                 % (tier.balancer_id, summary.get("requests", 0),
+                    summary.get("ok", 0), summary.get("shed", 0),
+                    summary.get("errors", 0),
+                    summary.get("retries", 0)))
+        if mon.enabled:
+            mon.emit("task_end", task="fleet_balancer",
                      requests=summary.get("requests", 0))
         return 0
 

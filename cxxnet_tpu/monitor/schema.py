@@ -81,15 +81,21 @@ REQUIRED: Dict[str, tuple] = {
     # record written to canary_out)
     "fleet_route": ("protocol", "status", "model", "tenant", "rows",
                     "replica", "version", "retries", "latency_ms",
-                    "coalesced", "channel"),
+                    "coalesced", "channel", "balancer"),
     # one per coalesced super-batch forward (fleet_coalesce_ms > 0):
     # how many client requests merged, the rows they carried, which
     # replica/channel answered, and the forward wall time — the
     # balancer-side twin of serve_batch (doc/serving.md "Fleet data
     # path")
     "fleet_batch": ("model", "replica", "status", "requests", "rows",
-                    "channel", "retries", "latency_ms"),
+                    "channel", "retries", "latency_ms", "balancer"),
     "fleet_scale": ("action", "replicas", "ready", "reason"),
+    # sharded front tier (doc/serving.md "Sharded front tier"): one
+    # record per quota-share rebalance on a door — which tenants'
+    # fractions moved toward observed demand, over what window. The
+    # fleet-wide over-admission bound is "configured rate x one such
+    # window" (tests/test_fleet_front_tier.py pins it)
+    "quota_rebalance": ("balancer", "tenants", "window_s", "shares"),
     "canary": ("phase", "baseline_version", "canary_version",
                "fraction", "reason"),
     # crash-safe checkpointing (doc/checkpointing.md): per-snapshot
@@ -181,7 +187,7 @@ _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",
                 "device_ms", "latency_p50_ms", "latency_p99_ms",
                 "rows_per_sec", "gather_ms", "serialize_ms",
                 "write_ms", "fsync_ms", "quantize_ms",
-                "backprop_ms", "reduce_ms", "step_ms")
+                "backprop_ms", "reduce_ms", "step_ms", "window_s")
 
 # ratio fields must sit in [0, 1]
 _RATIO_KEYS = ("buffer_reuse_rate", "h2d_overlap_ratio", "fill_rate",

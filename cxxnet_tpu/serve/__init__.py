@@ -30,7 +30,9 @@ from .batcher import (DynamicBatcher, ServeBusyError, ServeClosedError,
 from .bucketing import (bucket_ladder, mesh_align, pad_to_bucket,
                         parse_buckets, pick_bucket)
 from .engine import InferenceEngine, StagedBatch, build_engine
-from .frontend import BinaryClient, FleetConfig, FleetServer
+from .frontend import (BinaryClient, FailoverBinaryClient,
+                       FailoverHttpClient, FleetConfig, FleetServer,
+                       registry_endpoints)
 from .quota import QuotaManager, TenantQuotaError, TokenBucket
 from .router import ModelRouter, UnknownModelError
 from .server import ServeConfig, ServeSession, run_closed_loop
@@ -41,7 +43,8 @@ __all__ = [
     "ServeTimeoutError", "bucket_ladder", "mesh_align", "pad_to_bucket",
     "parse_buckets", "pick_bucket", "InferenceEngine", "StagedBatch",
     "build_engine", "ServeConfig", "ServeSession", "run_closed_loop",
-    "BinaryClient", "FleetConfig", "FleetServer", "QuotaManager",
+    "BinaryClient", "FailoverBinaryClient", "FailoverHttpClient",
+    "registry_endpoints", "FleetConfig", "FleetServer", "QuotaManager",
     "TenantQuotaError", "TokenBucket", "ModelRouter",
     "UnknownModelError", "SnapshotWatcher", "latest_verified",
 ]

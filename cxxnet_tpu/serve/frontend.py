@@ -35,6 +35,7 @@ sheds additionally emit ``tenant_shed``; swaps emit ``hot_swap``.
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import socket
@@ -262,6 +263,9 @@ class BinaryClient:
     def __init__(self, host: str, port: int, timeout: float = 30.0):
         self.sock = socket.create_connection((host, port),
                                              timeout=timeout)
+        # request/reply framing over small segments: Nagle + delayed
+        # ACK turns every exchange into a ~40ms stall
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._rfile = self.sock.makefile("rb")
 
     def predict(self, rows: np.ndarray, model: str = "",
@@ -276,6 +280,200 @@ class BinaryClient:
             self._rfile.close()
         finally:
             self.sock.close()
+
+
+def registry_endpoints(path: str, role: str = "balancer",
+                       proto: str = "binary"
+                       ) -> List[Tuple[str, int]]:
+    """``(host, port)`` endpoints of one role from the fleet's
+    endpoint-registry file (fleet/placement.py grammar) — how a
+    failover client discovers the front doors without knowing the
+    controller. Draining/disabled entries are skipped."""
+    with open(path) as f:
+        doc = json.load(f)
+    key = "%s_port" % ("binary" if proto == "binary" else "http")
+    out = []
+    for e in sorted(dict(doc.get("endpoints", {})).values(),
+                    key=lambda e: str(e.get("id", ""))):
+        if e.get("role") != role or e.get("draining"):
+            continue
+        port = int(e.get(key, 0))
+        if port > 0:
+            out.append((str(e.get("host", "127.0.0.1")), port))
+    return out
+
+
+class FailoverBinaryClient:
+    """A :class:`BinaryClient` over MULTIPLE endpoints — the client
+    half of the sharded front tier's zero-drop contract.
+
+    Connects to one door (rotating over the list until a connect
+    succeeds); any transport failure mid-exchange (refused/reset
+    connection, torn frame: the signature of a door dying) — or a
+    graceful ``closed`` reply from a draining door — closes the
+    connection, advances to the next door, and retries the SAME rows —
+    ``predict`` is idempotent, so a SIGKILLed balancer costs a
+    reconnect, never a failed request. Raises IOError only when every
+    endpoint refused ``attempts`` times over."""
+
+    def __init__(self, endpoints: Sequence[Tuple[str, int]],
+                 timeout: float = 30.0, attempts: int = 0):
+        if not endpoints:
+            raise ValueError("failover client needs >= 1 endpoint")
+        self.endpoints = [(h, int(p)) for h, p in endpoints]
+        self.timeout = timeout
+        # default: two passes over the doors — one transient failure
+        # per door plus the reconnect that lands on a live one
+        self.attempts = attempts or 2 * len(self.endpoints)
+        self._i = 0
+        self.sock: Optional[socket.socket] = None
+        self._rfile = None
+        self.failovers = 0
+
+    @classmethod
+    def from_registry(cls, path: str,
+                      timeout: float = 30.0) -> "FailoverBinaryClient":
+        return cls(registry_endpoints(path, "balancer", "binary"),
+                   timeout=timeout)
+
+    def _connect(self) -> None:
+        last: Optional[BaseException] = None
+        for _ in range(len(self.endpoints)):
+            host, port = self.endpoints[self._i % len(self.endpoints)]
+            try:
+                self.sock = socket.create_connection(
+                    (host, port), timeout=self.timeout)
+                self.sock.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_NODELAY, 1)
+                self._rfile = self.sock.makefile("rb")
+                return
+            except OSError as e:
+                last = e
+                self.sock = None
+                self._i += 1
+        raise IOError("no balancer endpoint reachable "
+                      "(last: %s)" % last)
+
+    def _drop(self) -> None:
+        if self._rfile is not None:
+            try:
+                self._rfile.close()
+            except OSError:
+                pass  # cxxlint: disable=CXL006 -- teardown of a dead socket on the failover path; nothing to do with a close error
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass  # cxxlint: disable=CXL006 -- teardown of a dead socket on the failover path; nothing to do with a close error
+        self.sock, self._rfile = None, None
+        self._i += 1           # next attempt tries the NEXT door
+        self.failovers += 1
+
+    def predict(self, rows: np.ndarray, model: str = "",
+                tenant: str = "",
+                timeout_ms: float = 0.0) -> Tuple[str, Any]:
+        last: Optional[BaseException] = None
+        for _ in range(self.attempts):
+            try:
+                if self.sock is None:
+                    self._connect()
+                self.sock.sendall(pack_request(model, tenant, rows,
+                                               timeout_ms))
+                status, result = read_reply(self._rfile)
+                if status == "closed":
+                    # a graceful goodbye: the door is draining away
+                    # and did NOT process the rows — same retry
+                    # contract as a dead socket
+                    last = IOError("door draining: %s" % (result,))
+                    self._drop()
+                    continue
+                return status, result
+            except (OSError, ValueError) as e:
+                # OSError: connect/send/recv died; ValueError: torn or
+                # garbled frame — either way the exchange is void and
+                # the idempotent rows retry on another door
+                last = e
+                self._drop()
+        raise IOError("predict failed through every balancer "
+                      "endpoint (last: %s)" % last)
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self._rfile.close()
+            finally:
+                self.sock.close()
+        self.sock, self._rfile = None, None
+
+
+class FailoverHttpClient:
+    """HTTP/JSON twin of :class:`FailoverBinaryClient`: POST
+    ``/v1/predict`` against a list of doors, retrying the idempotent
+    body on the next door after any transport-level failure.
+    ``predict`` returns ``(http_code, decoded_json_body)``."""
+
+    def __init__(self, endpoints: Sequence[Tuple[str, int]],
+                 timeout: float = 30.0, attempts: int = 0):
+        if not endpoints:
+            raise ValueError("failover client needs >= 1 endpoint")
+        self.endpoints = [(h, int(p)) for h, p in endpoints]
+        self.timeout = timeout
+        self.attempts = attempts or 2 * len(self.endpoints)
+        self._i = 0
+        self._conn: Optional[http.client.HTTPConnection] = None
+        self.failovers = 0
+
+    @classmethod
+    def from_registry(cls, path: str,
+                      timeout: float = 30.0) -> "FailoverHttpClient":
+        return cls(registry_endpoints(path, "balancer", "http"),
+                   timeout=timeout)
+
+    def _drop(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass  # cxxlint: disable=CXL006 -- teardown of a dead connection on the failover path; nothing to do with a close error
+        self._conn = None
+        self._i += 1
+        self.failovers += 1
+
+    def predict(self, model: str, tenant: str, rows,
+                timeout_ms: float = 0.0) -> Tuple[int, Dict[str, Any]]:
+        body = json.dumps({
+            "model": model, "tenant": tenant,
+            "rows": np.asarray(rows, dtype=np.float32).tolist(),
+            **({"timeout_ms": timeout_ms} if timeout_ms else {})})
+        last: Optional[BaseException] = None
+        for _ in range(self.attempts):
+            host, port = self.endpoints[self._i % len(self.endpoints)]
+            try:
+                if self._conn is None:
+                    self._conn = http.client.HTTPConnection(
+                        host, port, timeout=self.timeout)
+                self._conn.request(
+                    "POST", "/v1/predict", body,
+                    {"Content-Type": "application/json"})
+                resp = self._conn.getresponse()
+                payload = json.loads(resp.read() or b"{}")
+                if payload.get("error") == "closed":
+                    # graceful drain reply: rows were NOT processed
+                    last = IOError("door draining")
+                    self._drop()
+                    continue
+                return resp.status, payload
+            except (OSError, ValueError,
+                    http.client.HTTPException) as e:
+                last = e
+                self._drop()
+        raise IOError("predict failed through every balancer "
+                      "endpoint (last: %s)" % last)
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+        self._conn = None
 
 
 # -- fleet configuration --------------------------------------------------
@@ -949,6 +1147,13 @@ class _HttpHandler(BaseHTTPRequestHandler):
 class _FleetBinaryServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
+
+    def process_request(self, request, client_address):
+        # the reply side writes header and payload as separate small
+        # segments; without TCP_NODELAY, Nagle holds the second one
+        # for the peer's delayed ACK (~40ms per exchange)
+        request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        super().process_request(request, client_address)
 
     def __init__(self, addr, handler, fleet: FleetServer):
         self.fleet = fleet

@@ -104,6 +104,26 @@ class TokenBucket:
             self._refill(now)
             return self._tokens
 
+    def reconfigure(self, rate: float, burst: float) -> None:
+        """Retune a live bucket in place (the fleet tier's quota-share
+        rebalancer). Accrued tokens are refilled at the OLD rate up to
+        now, then clamped to the new burst — a share cut cannot mint
+        tokens, and a share raise keeps only what was already banked.
+        Unchanged parameters return without touching state (the
+        single-door fleet stays bit-identical under rebalancing)."""
+        if rate <= 0:
+            raise ValueError("token bucket rate must be > 0")
+        if burst <= 0:
+            raise ValueError("token bucket burst must be > 0")
+        now = time.monotonic()
+        with self._lock:
+            if rate == self.rate and burst == self.burst:
+                return
+            self._refill(now)
+            self.rate = float(rate)
+            self.burst = float(burst)
+            self._tokens = min(self._tokens, self.burst)
+
 
 def _parse_bucket_spec(spec: str) -> Optional[Tuple[float, float]]:
     """``rate[:burst]`` -> (rate, burst); rate 0 means unlimited

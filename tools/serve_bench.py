@@ -645,13 +645,16 @@ def _seal_bench_bundle(cfg, snapshot, monitor):
     return out
 
 
-def _client_proc_main(port, pool, n_clients, requests, request_rows,
+def _client_proc_main(ports, pool, n_clients, requests, request_rows,
                       base_ci, outq):
     """One driver WORKER PROCESS: n_clients closed-loop threads
-    against the balancer. Living in its own process keeps the client
-    threads' GIL pressure out of the balancer process — in production
-    clients are not the balancer's threads, and measuring them there
-    charges their scheduling to the balancer's p99."""
+    against the balancer tier (client ``ci`` pins to door
+    ``ports[ci % len(ports)]`` — round-robin over a sharded front
+    tier, the single port in a one-door fleet). Living in its own
+    process keeps the client threads' GIL pressure out of the balancer
+    process — in production clients are not the balancer's threads,
+    and measuring them there charges their scheduling to the
+    balancer's p99."""
     import threading
 
     from cxxnet_tpu.serve import BinaryClient
@@ -662,7 +665,8 @@ def _client_proc_main(port, pool, n_clients, requests, request_rows,
     def client(ci):
         lats = []
         try:
-            bc = BinaryClient("127.0.0.1", port, timeout=120)
+            bc = BinaryClient("127.0.0.1", ports[ci % len(ports)],
+                              timeout=120)
         except OSError as e:
             with lock:
                 counts["failed"].append(repr(e))
@@ -703,17 +707,21 @@ def _client_proc_main(port, pool, n_clients, requests, request_rows,
 
 
 def _drive_fleet(ctl, pool, clients, requests, request_rows,
-                 mid_traffic=None, procs=4):
+                 mid_traffic=None, procs=4, ports=None):
     """Closed-loop binary clients against the balancer, spread over
     a few driver WORKER PROCESSES (the clients' own thread scheduling
     must not ride the balancer process); returns per-outcome counts
     including client-side latencies. ``mid_traffic`` (optional
     callable) runs on the driver thread once traffic is established —
     the kill injector. Sheds (busy/over_quota) are back-off signals,
-    not failures; anything else non-ok is a failed request."""
+    not failures; anything else non-ok is a failed request. ``ports``
+    overrides the target endpoints (the sharded front tier's door
+    list); default is the controller's in-process balancer."""
     import multiprocessing as mp
 
     ctx = mp.get_context("fork")
+    if ports is None:
+        ports = [ctl.balancer.binary_port]
     procs = max(1, min(procs, clients))
     outq = ctx.Queue()
     share = [clients // procs + (1 if i < clients % procs else 0)
@@ -725,7 +733,7 @@ def _drive_fleet(ctl, pool, clients, requests, request_rows,
         if not n:
             continue
         p = ctx.Process(target=_client_proc_main,
-                        args=(ctl.balancer.binary_port, pool, n,
+                        args=(list(ports), pool, n,
                               requests, request_rows, base, outq))
         p.start()
         workers.append(p)
@@ -1245,6 +1253,614 @@ def run_multi_replica(args, monitor, sink):
     return record, failures == 0 and slo_ok, recompiles == 0
 
 
+# -- sharded front tier scenario (--balancers) -----------------------------
+
+
+def _null_replica_main(port_file):
+    """A no-engine fleet replica for FRONT-TIER isolation: answers
+    both binary protocol versions instantly (ok, one float per row)
+    and ``/healthz`` with a healthy body. Driving N doors over null
+    replicas measures the balancer tier itself — frame parse, quota
+    admit, route, forward — with model dispatch taken out of the
+    denominator (the ``run_datapath_micro`` methodology applied one
+    tier up)."""
+    import socket
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from cxxnet_tpu.fleet.placement import write_endpoint_file
+    from cxxnet_tpu.serve.frontend import (BIN_MAGIC_V2, STATUS_OK,
+                                           _REQ_HEADER,
+                                           _REQ_HEADER_V2, _read_exact,
+                                           pack_reply, pack_reply_v2)
+
+    class _Health(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps({"ok": 1, "queue_rows": 0,
+                               "model_health": []}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):   # no access log
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Health)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(128)
+
+    def serve_conn(sock):
+        rfile = sock.makefile("rb")
+        try:
+            while True:
+                magic = _read_exact(rfile, 4)
+                if magic is None or len(magic) < 4:
+                    return
+                if magic == BIN_MAGIC_V2:
+                    rest = _read_exact(rfile, _REQ_HEADER_V2.size - 4)
+                    if rest is None \
+                            or len(rest) < _REQ_HEADER_V2.size - 4:
+                        return
+                    (_, corr, ml, tl, nrows, elems,
+                     _t) = _REQ_HEADER_V2.unpack(magic + rest)
+                else:
+                    rest = _read_exact(rfile, _REQ_HEADER.size - 4)
+                    if rest is None \
+                            or len(rest) < _REQ_HEADER.size - 4:
+                        return
+                    (_, ml, tl, nrows, elems,
+                     _t) = _REQ_HEADER.unpack(magic + rest)
+                    corr = None
+                if ml + tl:
+                    _read_exact(rfile, ml + tl)
+                if nrows * elems:
+                    _read_exact(rfile, nrows * elems * 4)
+                if corr is None:
+                    sock.sendall(pack_reply(
+                        STATUS_OK, np.zeros((nrows, 1), "<f4")))
+                elif nrows == 0:
+                    sock.sendall(pack_reply_v2(corr, STATUS_OK))
+                else:
+                    sock.sendall(pack_reply_v2(
+                        corr, STATUS_OK, np.zeros((nrows, 1), "<f4")))
+        except (OSError, ValueError):
+            return   # client went away / torn frame: drop the conn
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass  # cxxlint: disable=CXL006 -- teardown of a dead client socket; nothing to do with a close error
+
+    def accept_loop():
+        while True:
+            conn, _ = lsock.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    write_endpoint_file(port_file, {
+        "pid": os.getpid(),
+        "http_port": httpd.server_address[1],
+        "binary_port": lsock.getsockname()[1]})
+    while True:
+        time.sleep(3600)
+
+
+def _spawn_null_replicas(ctx, td, n):
+    """Fork ``n`` null replicas; returns [(proc, ports_dict)]."""
+    import os
+    nulls = []
+    for i in range(n):
+        pf = os.path.join(td, "null%d.ports.json" % i)
+        p = ctx.Process(target=_null_replica_main, args=(pf,),
+                        daemon=True)
+        p.start()
+        deadline = time.time() + 30
+        while not os.path.exists(pf):
+            assert p.is_alive(), "null replica %d died booting" % i
+            assert time.time() < deadline, \
+                "null replica %d: no port file" % i
+            time.sleep(0.02)
+        with open(pf) as f:
+            nulls.append((p, json.load(f)))
+    return nulls
+
+
+def _boot_front_tier(td, tag, n, nulls, extra_conf="",
+                     monitor_dir=""):
+    """Boot ``n`` real ``task=fleet_balancer`` door processes over the
+    null replicas: the bench stands in for the controller — it writes
+    the endpoint registry (replicas first, then each door as it
+    publishes ports) and the doors reconcile from it. Returns
+    (manager, registry, doors) with every door reporting all replicas
+    ready and the full peer set."""
+    import os
+
+    from cxxnet_tpu.fleet import FleetTierConfig
+    from cxxnet_tpu.fleet.placement import (BalancerManager,
+                                            EndpointRegistry,
+                                            endpoint_entry)
+    from cxxnet_tpu.utils.config import parse_config
+
+    fleet_dir = os.path.join(td, "front_%s" % tag)
+    conf_text = ("fleet_source = null-model\n"
+                 "fleet_balancers = %d\n"
+                 "fleet_dir = %s\n"
+                 "fleet_gossip_s = 0.2\n"
+                 "fleet_health_poll_s = 0.2\n" % (n, fleet_dir)) \
+        + extra_conf
+    conf_path = os.path.join(td, "front_%s.conf" % tag)
+    with open(conf_path, "w") as f:
+        f.write(conf_text)
+    tier = FleetTierConfig(parse_config(conf_text))
+    registry = EndpointRegistry(tier.registry_path)
+    registry.write([
+        endpoint_entry("r%03d" % (i + 1), "replica", "127.0.0.1",
+                       ports["http_port"], ports["binary_port"],
+                       version="null", pid=ports["pid"])
+        for i, (_p, ports) in enumerate(nulls)])
+    mgr = BalancerManager(conf_path, tier, monitor_dir=monitor_dir)
+    doors = []
+    try:
+        for i in range(n):
+            door = mgr.spawn(i)
+            registry.upsert(endpoint_entry(
+                door.balancer_id, "balancer", door.host,
+                door.http_port, door.binary_port, pid=door.pid))
+            doors.append(door)
+        # doors sync the registry on a 0.2 s cadence: wait until every
+        # door has polled all replicas healthy and knows its peers
+        deadline = time.time() + 30
+        for door in doors:
+            while True:
+                try:
+                    h = _get_json(door.http_port, "/healthz")
+                    if h.get("ready", 0) >= len(nulls) \
+                            and h.get("balancers", 0) >= n:
+                        break
+                except (OSError, ValueError):
+                    pass  # cxxlint: disable=CXL006 -- door still binding its listener; the deadline below is the real guard
+                assert time.time() < deadline, \
+                    "door %s never became ready" % door.balancer_id
+                time.sleep(0.05)
+    except BaseException:
+        mgr.close()
+        raise
+    return mgr, registry, doors
+
+
+def _front_point_stats(counts, request_rows):
+    """One front-tier drive summarized from CLIENT-side counts (the
+    doors are separate processes; their telemetry is captured
+    separately via per-door monitor files)."""
+    clat = sorted(counts.get("lat", []))
+
+    def cpct(q):
+        return round(clat[min(len(clat) - 1,
+                              int(q * len(clat)))] * 1e3, 3) \
+            if clat else 0.0
+
+    return {
+        "client_p50_ms": cpct(0.50), "client_p99_ms": cpct(0.99),
+        "requests_ok": counts["ok"], "requests_shed": counts["shed"],
+        "requests_failed": len(counts["failed"]),
+        "rows_per_sec": round(
+            counts["ok"] * request_rows / counts["wall_s"], 2)
+        if counts["wall_s"] > 0 else 0.0,
+        "wall_s": round(counts["wall_s"], 2),
+    }
+
+
+def _failover_proc_main(bin_eps, http_eps, pool, n_clients, requests,
+                        request_rows, base_ci, outq):
+    """One kill-scenario WORKER PROCESS: even clients drive the binary
+    protocol, odd clients HTTP/JSON, all through the failover clients
+    holding the FULL door list — a SIGKILLed door must cost a silent
+    reconnect, never a failed request."""
+    import threading
+
+    from cxxnet_tpu.serve import FailoverBinaryClient, FailoverHttpClient
+
+    counts = {"ok": 0, "shed": 0, "failed": [], "lat": [],
+              "failovers": 0}
+    lock = threading.Lock()
+
+    def client(ci):
+        lats = []
+        http_mode = ci % 2 == 1
+        # rotate the endpoint list per client so load starts spread
+        # over every door — including the one about to be killed
+        off = (ci // 2) % len(bin_eps)
+        eps = (http_eps if http_mode else bin_eps)
+        eps = eps[off:] + eps[:off]
+        fc = FailoverHttpClient(eps, timeout=120) if http_mode \
+            else FailoverBinaryClient(eps, timeout=120)
+        try:
+            for r in range(requests):
+                start = (ci * requests + r) * request_rows % 256
+                rows = np.take(pool,
+                               range(start, start + request_rows),
+                               axis=0, mode="wrap")
+                t0 = time.time()
+                try:
+                    if http_mode:
+                        code, _body = fc.predict("", "", rows)
+                        status = "ok" if code == 200 else (
+                            "shed" if code == 429 else "failed:%d"
+                            % code)
+                    else:
+                        status, _ = fc.predict(rows)
+                except Exception as e:
+                    with lock:
+                        counts["failed"].append(repr(e))
+                    break
+                lats.append(time.time() - t0)
+                with lock:
+                    if status in ("ok", "busy", "over_quota", "shed"):
+                        counts["ok" if status == "ok"
+                               else "shed"] += 1
+                    else:
+                        counts["failed"].append(status)
+        finally:
+            fc.close()
+            with lock:
+                counts["lat"].extend(lats)
+                counts["failovers"] += fc.failovers
+
+    threads = [threading.Thread(target=client, args=(base_ci + i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    outq.put(counts)
+
+
+def _drive_failover(doors, pool, clients, requests, request_rows,
+                    mid_traffic=None, procs=2):
+    """The kill drive: HTTP+binary failover clients over every door,
+    spread over worker processes like ``_drive_fleet``."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("fork")
+    bin_eps = [("127.0.0.1", d.binary_port) for d in doors]
+    http_eps = [("127.0.0.1", d.http_port) for d in doors]
+    procs = max(1, min(procs, clients))
+    outq = ctx.Queue()
+    share = [clients // procs + (1 if i < clients % procs else 0)
+             for i in range(procs)]
+    workers = []
+    base = 0
+    t0 = time.time()
+    for n in share:
+        if not n:
+            continue
+        p = ctx.Process(target=_failover_proc_main,
+                        args=(bin_eps, http_eps, pool, n, requests,
+                              request_rows, base, outq))
+        p.start()
+        workers.append(p)
+        base += n
+    if mid_traffic is not None:
+        mid_traffic()
+    counts = {"ok": 0, "shed": 0, "failed": [], "lat": [],
+              "failovers": 0}
+    for _ in workers:
+        c = outq.get(timeout=600)
+        for k in ("ok", "shed", "failovers"):
+            counts[k] += c[k]
+        counts["failed"].extend(c["failed"])
+        counts["lat"].extend(c["lat"])
+    for p in workers:
+        p.join(timeout=60)
+    counts["wall_s"] = time.time() - t0
+    return counts
+
+
+def _front_quota_drive(doors, pool, request_rows, duration_s):
+    """Hammer tenant ``hog`` (quota'd fleet-wide) and tenant ``good``
+    (unquoted) through EVERY door at once; returns per-door, per-
+    tenant outcome counts plus the measured wall."""
+    import threading
+
+    from cxxnet_tpu.serve import BinaryClient
+
+    res = {t: {d.balancer_id: {"ok": 0, "shed": 0, "failed": 0}
+               for d in doors} for t in ("hog", "good")}
+    lock = threading.Lock()
+    rows = pool[:request_rows]
+    stop_at = time.time() + duration_s
+
+    def drive(tenant, door):
+        slot = res[tenant][door.balancer_id]
+        try:
+            bc = BinaryClient("127.0.0.1", door.binary_port,
+                              timeout=60)
+        except OSError:
+            with lock:
+                slot["failed"] += 1
+            return
+        try:
+            while time.time() < stop_at:
+                try:
+                    status, _ = bc.predict(rows, tenant=tenant)
+                except Exception:
+                    with lock:
+                        slot["failed"] += 1
+                    return
+                with lock:
+                    if status == "ok":
+                        slot["ok"] += 1
+                    elif status == "over_quota":
+                        slot["shed"] += 1
+                    else:
+                        slot["failed"] += 1
+                # realistic clients back off on a shed / pace a
+                # light tenant; a shed-speed spin would just burn
+                # the single CPU every process here shares
+                if status != "ok" or tenant == "good":
+                    time.sleep(0.02 if tenant == "good" else 0.005)
+        finally:
+            bc.close()
+
+    threads = [threading.Thread(target=drive, args=(t, d))
+               for d in doors
+               for t in ("hog", "hog", "good")]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    res["wall_s"] = time.time() - t0
+    return res
+
+
+def _door_sheds_typed_429(door, rows):
+    """POST over-quota traffic at ONE door until it answers the typed
+    429 contract: status 429, JSON error=over_quota, Retry-After."""
+    import http.client
+    body = json.dumps({"model": "", "tenant": "hog",
+                       "rows": rows.tolist()})
+    for _ in range(100):
+        conn = http.client.HTTPConnection("127.0.0.1", door.http_port,
+                                          timeout=10)
+        try:
+            conn.request("POST", "/v1/predict", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            payload = json.loads(resp.read() or b"{}")
+            if resp.status == 429 \
+                    and payload.get("error") == "over_quota" \
+                    and resp.getheader("Retry-After"):
+                return True
+        finally:
+            conn.close()
+    return False
+
+
+def run_front_tier(args, monitor, sink):
+    """``--balancers N1,N2,...``: the sharded front tier measured in
+    isolation. Each point boots N real ``task=fleet_balancer``
+    processes over engine-less null replicas and drives a FIXED total
+    client count round-robin across the doors — so rows/s differences
+    come from sharding the tier, not from changing offered load — with
+    median-of-``--front-repeats`` and a per-point spread field. At the
+    largest N: the SIGKILL-a-door scenario (concurrent HTTP+binary
+    failover clients, zero failed requests) and the distributed-quota
+    scenario (typed 429 through every door; fleet-wide admitted rate
+    bounded by the configured rate plus one rebalance window)."""
+    import multiprocessing as mp
+    import os
+    import signal
+    import tempfile
+
+    from cxxnet_tpu.monitor.schema import read_jsonl, validate_records
+
+    sizes = [int(t) for t in args.balancers.split(",") if t]
+    repeats = max(1, args.front_repeats)
+    rng = np.random.RandomState(0)
+    pool = rng.uniform(0, 1, size=(256, 16)).astype(np.float32)
+    rate, burst = 200.0, 40.0
+    rebalance_s = 0.5
+    record = {
+        "name": "serve_bench", "mode": "front_tier",
+        "t": time.time(),
+        "requests_per_client": args.requests,
+        "request_rows": args.request_rows,
+        "clients_total": args.front_clients,
+        "null_replicas": args.front_replicas,
+        "repeats": repeats,
+        "isolation": "doors forward to engine-less null replicas: "
+                     "the capture measures the balancer tier (frame "
+                     "parse, quota admit, route, forward), not model "
+                     "dispatch",
+        "caveat": "single-CPU container: all doors share one core, "
+                  "so scaling gains come from splitting a fixed "
+                  "client load across smaller per-process thread "
+                  "sets (less GIL/scheduler contention), not from "
+                  "added compute; expect noisy, sub-linear points",
+    }
+    failures = 0
+    ctx = mp.get_context("fork")
+    with tempfile.TemporaryDirectory() as td:
+        nulls = _spawn_null_replicas(ctx, td, args.front_replicas)
+        try:
+            sweep = []
+            for n in sizes:
+                t0 = time.time()
+                mgr, _reg, doors = _boot_front_tier(td, "n%d" % n, n,
+                                                    nulls)
+                boot_s = time.time() - t0
+                try:
+                    runs = []
+                    for _ in range(repeats):
+                        counts = _drive_fleet(
+                            None, pool, clients=args.front_clients,
+                            requests=args.requests,
+                            request_rows=args.request_rows,
+                            ports=[d.binary_port for d in doors])
+                        runs.append(_front_point_stats(
+                            counts, args.request_rows))
+                finally:
+                    mgr.close()
+                rates = sorted(r["rows_per_sec"] for r in runs)
+                mid = runs[[r["rows_per_sec"]
+                            for r in runs].index(rates[len(rates)
+                                                       // 2])]
+                pt = dict(mid, balancers=n,
+                          boot_s=round(boot_s, 2),
+                          rows_per_sec=rates[len(rates) // 2],
+                          rows_per_sec_runs=rates,
+                          rows_per_sec_spread=round(
+                              rates[-1] - rates[0], 2))
+                failures += sum(r["requests_failed"] for r in runs)
+                sweep.append(pt)
+                print("# balancers=%d: median %.1f rows/s (spread "
+                      "%.1f over %d runs), client p50 %.2f ms p99 "
+                      "%.2f ms, %d ok / %d failed"
+                      % (n, pt["rows_per_sec"],
+                         pt["rows_per_sec_spread"], repeats,
+                         pt["client_p50_ms"], pt["client_p99_ms"],
+                         pt["requests_ok"], pt["requests_failed"]),
+                      file=sys.stderr)
+            record["sweep"] = sweep
+            med = [p["rows_per_sec"] for p in sweep]
+            record["rows_per_sec_monotonic"] = all(
+                b > a for a, b in zip(med, med[1:]))
+
+            # -- distributed quota + kill-a-door at the largest N ----
+            n = max(sizes)
+            quota_conf = ("serve_quota = hog:%g:%g\n"
+                          "fleet_quota_rebalance_s = %g\n"
+                          % (rate, burst, rebalance_s))
+            mdir = os.path.join(td, "door_telemetry")
+            os.makedirs(mdir, exist_ok=True)
+            mgr, _reg, doors = _boot_front_tier(
+                td, "quota", n, nulls, extra_conf=quota_conf,
+                monitor_dir=mdir)
+            try:
+                qrows = 4
+                q = _front_quota_drive(doors, pool, qrows,
+                                       duration_s=6.0)
+                wall = q["wall_s"]
+                admitted = sum(s["ok"] for s in q["hog"].values()) \
+                    * qrows
+                bound = rate * (wall + rebalance_s) + burst
+                # probe rows > fleet burst: no door's share can ever
+                # admit it, so the FIRST well-formed answer must be
+                # the typed 429 regardless of how shares rebalanced
+                typed = {d.balancer_id:
+                         _door_sheds_typed_429(d, pool)
+                         for d in doors}
+                shares = {}
+                for d in doors:
+                    try:
+                        h = _get_json(d.http_port, "/healthz")
+                        shares[d.balancer_id] = h.get("quota_shares")
+                    except (OSError, ValueError):
+                        shares[d.balancer_id] = None
+                quota_rec = {
+                    "balancers": n, "rate": rate, "burst": burst,
+                    "rebalance_s": rebalance_s, "wall_s":
+                    round(wall, 2),
+                    "hog": {b: dict(s) for b, s in q["hog"].items()},
+                    "good": {b: dict(s)
+                             for b, s in q["good"].items()},
+                    "admitted_rows": admitted,
+                    "admitted_rows_per_sec": round(admitted / wall, 2)
+                    if wall else 0.0,
+                    "bound_rows": round(bound, 1),
+                    "within_bound": admitted <= bound,
+                    "typed_429_every_door": all(typed.values()),
+                    "typed_429_by_door": typed,
+                    "quota_shares": shares,
+                }
+                every_door_shed = all(
+                    s["shed"] > 0 for s in q["hog"].values())
+                good_ok = all(s["ok"] > 0 and s["failed"] == 0
+                              for s in q["good"].values())
+                quota_rec["hog_shed_every_door"] = every_door_shed
+                quota_rec["in_quota_clean"] = good_ok
+                record["distributed_quota"] = quota_rec
+                if not (quota_rec["within_bound"] and every_door_shed
+                        and good_ok
+                        and quota_rec["typed_429_every_door"]):
+                    failures += 1
+                print("# quota: admitted %.1f rows/s vs bound %.1f "
+                      "(rate %g + one %gs rebalance window), 429 "
+                      "through every door=%s, in-quota clean=%s"
+                      % (quota_rec["admitted_rows_per_sec"],
+                         bound / wall if wall else 0.0, rate,
+                         rebalance_s,
+                         quota_rec["typed_429_every_door"], good_ok),
+                      file=sys.stderr)
+
+                # -- SIGKILL a door under HTTP+binary load ----------
+                victim = doors[-1]
+
+                def killer():
+                    time.sleep(0.25)      # let traffic establish
+                    os.kill(victim.pid, signal.SIGKILL)
+                    print("# killed balancer %s (pid %d) mid-traffic"
+                          % (victim.balancer_id, victim.pid),
+                          file=sys.stderr)
+
+                counts = _drive_failover(
+                    doors, pool, clients=args.front_clients,
+                    requests=args.requests,
+                    request_rows=args.request_rows,
+                    mid_traffic=killer if n > 1 else None)
+                kill_pt = dict(
+                    _front_point_stats(counts, args.request_rows),
+                    balancers=n, balancer_killed=n > 1,
+                    failovers=counts["failovers"])
+                failures += kill_pt["requests_failed"]
+                record["kill_balancer"] = kill_pt
+                print("# kill-a-balancer: %d ok / %d shed / %d "
+                      "failed, %d failovers"
+                      % (kill_pt["requests_ok"],
+                         kill_pt["requests_shed"],
+                         kill_pt["requests_failed"],
+                         kill_pt["failovers"]), file=sys.stderr)
+            finally:
+                mgr.close()
+            # the doors' own telemetry streams (monitor=jsonl per
+            # door): schema-validated, and the route records must
+            # carry each door's balancer id
+            door_events = {"fleet_route": 0, "tenant_shed": 0,
+                           "quota_rebalance": 0}
+            route_doors = set()
+            for fn in sorted(os.listdir(mdir)):
+                recs = read_jsonl(os.path.join(mdir, fn))
+                errs = validate_records(recs, strict=False)
+                assert not errs, \
+                    "door %s emitted schema-invalid telemetry: %s" \
+                    % (fn, errs[:5])
+                for r in recs:
+                    if r["event"] in door_events:
+                        door_events[r["event"]] += 1
+                    if r["event"] == "fleet_route":
+                        route_doors.add(r["balancer"])
+            record["door_telemetry"] = dict(
+                door_events, route_balancers=sorted(route_doors),
+                streams=len(os.listdir(mdir)))
+        finally:
+            for p, _ports in nulls:
+                p.terminate()
+            for p, _ports in nulls:
+                p.join(timeout=10)
+    record["zero_failed_requests"] = failures == 0
+    record["zero_recompiles"] = True     # nothing compiles: no engines
+    return record, failures == 0, True
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", default="1,2,4,8",
@@ -1305,6 +1921,26 @@ def main(argv=None) -> int:
                     help="with --replicas: multiplexed v2 channels "
                          "per replica (fleet_channels_per_replica); "
                          "0 = the pooled v1 data path")
+    ap.add_argument("--balancers", default="",
+                    help="comma list of front-tier sizes (e.g. 1,2,4):"
+                         " boot N task=fleet_balancer processes over "
+                         "engine-less null replicas and measure the "
+                         "sharded front tier in isolation — fixed "
+                         "total client count split across the doors, "
+                         "median-of---front-repeats rows/s per point, "
+                         "then kill-a-door (zero failed requests) and "
+                         "distributed-quota scenarios at the largest N")
+    ap.add_argument("--front-clients", type=int, default=16,
+                    help="TOTAL concurrent clients for --balancers "
+                         "(held fixed across front-tier sizes so the "
+                         "offered load is identical at every point)")
+    ap.add_argument("--front-replicas", type=int, default=2,
+                    help="null replicas behind the front tier for "
+                         "--balancers")
+    ap.add_argument("--front-repeats", type=int, default=3,
+                    help="repetitions per --balancers point; the "
+                         "headline rows/s is the median and the "
+                         "record carries the per-point spread")
     ap.add_argument("--fleet-baseline", action="store_true",
                     help="with --replicas: also sweep the legacy "
                          "data path (pooled connections, no "
@@ -1366,11 +2002,28 @@ def main(argv=None) -> int:
     if (args.coalesce_ms or args.fleet_baseline) \
             and not args.replicas:
         ap.error("--coalesce-ms/--fleet-baseline need --replicas")
+    if args.balancers and (args.replicas or args.tenants
+                           or args.generations or args.artifact):
+        ap.error("--balancers is its own scenario (front tier over "
+                 "null replicas); drop "
+                 "--replicas/--tenants/--generations/--artifact")
 
     from cxxnet_tpu.monitor import MemorySink, Monitor
     import jax
     sink = MemorySink()
     monitor = Monitor(sink)
+    if args.balancers:
+        rec, clean, _zero = run_front_tier(args, monitor, sink)
+        rec["platform"] = jax.default_backend()
+        out = json.dumps(rec, sort_keys=True)
+        print(out)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(out + "\n")
+        # exit-code convention (bench.py): 3 = a request failed, a
+        # door kill dropped traffic, or the quota bound was breached;
+        # no engines run so recompiles cannot occur
+        return 0 if clean else 3
     if args.generations:
         rec, clean, zero_recompiles = run_continual_soak(
             args, monitor, sink)
