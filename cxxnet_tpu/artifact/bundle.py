@@ -190,12 +190,18 @@ def fingerprint_sha(fp: Dict[str, Any]) -> str:
 
 
 def export_bundle(engine, out: str, node: str = "",
-                  monitor=None) -> Dict[str, Any]:
+                  monitor=None, retrieval=None) -> Dict[str, Any]:
     """Seal a warmed engine into a committed bundle at ``out``.
 
     ``engine`` is a warmed :class:`~cxxnet_tpu.serve.engine.
     InferenceEngine`: its trainer holds the verified weights and its
     program registry holds the compiled bucket-ladder executables.
+    ``retrieval`` (a warmed :class:`~cxxnet_tpu.retrieval.engine.
+    RetrievalEngine`, or None) additionally seals its embedding index
+    as a digest-verified member beside the snapshot — model and index
+    then commit, verify, and hot-swap as ONE artifact, and the search
+    executables (which live in the same program registry) serialize
+    with the pred ladder.
     Write order is the commit protocol: members first (each durably
     committed — local tmp+fsync+rename, see :func:`_commit_member`),
     manifest second, a directory fsync, then ``MANIFEST.json.ok``
@@ -224,9 +230,12 @@ def export_bundle(engine, out: str, node: str = "",
             "re-export over a committed bundle" % ok_uri)
     # sweep program members of any previous export at this path: a
     # re-export with fewer programs must not leave orphan executables
-    # the new manifest no longer vouches for
+    # the new manifest no longer vouches for. The index member sweeps
+    # for the same reason — an index-less re-export must not leave an
+    # orphan corpus the new manifest never mentions
+    from ..retrieval.index import INDEX_MEMBER
     for name in list_stream_dir(out):
-        if _PROG_RE.match(name):
+        if _PROG_RE.match(name) or name == INDEX_MEMBER:
             remove_stream(member_uri(out, name))
     arrays, meta = trainer.gather_snapshot()
     # serialize once and keep the bytes: the members row needs their
@@ -251,6 +260,20 @@ def export_bundle(engine, out: str, node: str = "",
                         "sha256": hashlib.sha256(blob).hexdigest()})
         programs.append({"name": name, "key": repr(key)})
         total += len(blob)
+    index_entry = None
+    if retrieval is not None:
+        idx_blob = retrieval.index.serialize()
+        index_entry = retrieval.index.manifest_entry()
+        # the served search contract: result depth + query-bucket
+        # ladder, so a boot requests exactly the sealed search keys
+        index_entry.update({"k": int(retrieval.k),
+                            "buckets": [int(b)
+                                        for b in retrieval.buckets]})
+        _commit_member(member_uri(out, index_entry["member"]), idx_blob)
+        members.append({
+            "name": index_entry["member"], "bytes": len(idx_blob),
+            "sha256": hashlib.sha256(idx_blob).hexdigest()})
+        total += len(idx_blob)
     manifest = {
         "format_version": BUNDLE_FORMAT_VERSION,
         "kind": BUNDLE_KIND,
@@ -272,6 +295,8 @@ def export_bundle(engine, out: str, node: str = "",
         "members": members,
         "programs": programs,
     }
+    if index_entry is not None:
+        manifest["index"] = index_entry
     man_bytes = json.dumps(manifest, sort_keys=True,
                            indent=1).encode()
     _commit_member(member_uri(out, MANIFEST_NAME), man_bytes)
@@ -427,6 +452,27 @@ def _manifest_malformed(manifest) -> str:
     for p in manifest["programs"]:
         if p["name"] not in names:
             return "manifest program %r has no members row" % p["name"]
+    # a sealed index is optional; when declared it must be a shaped
+    # object AND digest-covered by a members row — an index outside
+    # the members list would verify OK here and then boot a server
+    # whose /v1/search has no (or torn) corpus bytes
+    idx = manifest.get("index")
+    if idx is not None:
+        if not isinstance(idx, dict):
+            return "manifest index is not an object"
+        for k, t in (("member", str), ("metric", str), ("node", str),
+                     ("rows", int), ("dim", int), ("k", int)):
+            if not isinstance(idx.get(k), t):
+                return "manifest index field %r is malformed" % k
+        ibuckets = idx.get("buckets")
+        if not isinstance(ibuckets, list) or not ibuckets \
+                or any(not isinstance(b, int) or b < 1
+                       for b in ibuckets):
+            return "manifest index buckets is not a non-empty list " \
+                   "of positive ints"
+        if idx["member"] not in names:
+            return ("manifest index member %r has no members row"
+                    % idx["member"])
     return ""
 
 
@@ -548,6 +594,33 @@ def load_bundle(path: str) -> Bundle:
         programs.append((key, blobs[p["name"]]))
     return Bundle(path, manifest, blobs[manifest["snapshot"]],
                   programs)
+
+
+def read_index_member(path: str, manifest: Dict[str, Any] = None
+                      ) -> bytes:
+    """Digest-verified bytes of a bundle's sealed embedding index, or
+    ``b""`` when the bundle seals no index. Size and sha256 are checked
+    against the members row (the membership itself is guaranteed by
+    ``_manifest_malformed``); a missing or torn member raises
+    :class:`BundleError` — the boot-time mirror of the verify path, so
+    a server can never come up on corpus bytes the manifest does not
+    vouch for."""
+    man = bundle_manifest(path) if manifest is None else manifest
+    idx = man.get("index")
+    if idx is None:
+        return b""
+    row = next(m for m in man["members"] if m["name"] == idx["member"])
+    try:
+        data = read_stream_bytes(member_uri(path, idx["member"]))
+    except (IOError, OSError) as e:
+        raise BundleError("bundle %s index member %s unreadable: %s"
+                          % (path, idx["member"], e)) from e
+    if len(data) != row["bytes"] \
+            or hashlib.sha256(data).hexdigest() != row["sha256"]:
+        raise BundleError(
+            "bundle %s index member %s fails verification (size/"
+            "sha256 mismatch)" % (path, idx["member"]))
+    return data
 
 
 def serve_cfg_from_bundle(path: str) -> List[Tuple[str, str]]:

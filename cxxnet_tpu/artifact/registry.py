@@ -134,6 +134,17 @@ def run_steps_sig(data_shape, dtype, label_shape, mask_is_none: bool,
             bool(mask_is_none), int(n_extra), int(n_steps))
 
 
+def search_sig(q_rows: int, dim: int, corpus_rows: int, k: int,
+               metric: str, dtype) -> tuple:
+    """The retrieval top-k signature: (query bucket, embedding dim,
+    corpus rows, k, similarity metric, query dtype). The corpus matrix
+    is a program *argument* (not a closure constant), so the executable
+    serializes into the bundle and a generation's index swap reuses the
+    same compiled program family."""
+    return (int(q_rows), int(dim), int(corpus_rows), int(k),
+            str(metric), str(dtype))
+
+
 def parse_key(text: str) -> tuple:
     """Recover a registry key from its ``repr`` (the bundle manifest
     encoding). Keys are tuples of primitives, so ``literal_eval`` is

@@ -44,7 +44,9 @@ commits an emergency snapshot, drains the fleet, and reports
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from ..artifact.bundle import default_bundle_path, export_bundle
 from ..nnet.checkpoint import CheckpointManager
@@ -83,6 +85,12 @@ class ContinualConfig:
     - ``continual_max_updates`` — safety bound on total applied
       updates (0 = unbounded); a gate that never passes ends the run
       here instead of looping forever.
+    - ``continual_index_rows`` — when > 0, capture the first N valid
+      training rows as a retrieval corpus and re-embed + rebuild the
+      embedding index with every generation's weights, sealed into the
+      generation bundle beside them (doc/retrieval.md) — the hot-swap
+      flips model and index as one unit. 0 (default) exports
+      index-less bundles.
     """
 
     def __init__(self, cfg: Sequence[Tuple[str, str]]):
@@ -96,6 +104,7 @@ class ContinualConfig:
         self.swap_timeout_s = 120.0
         self.linger_s = 0.0
         self.max_updates = 0
+        self.index_rows = 0
         for name, val in cfg:
             if name == "continual_generations":
                 self.generations = int(val)
@@ -125,6 +134,8 @@ class ContinualConfig:
                 self.linger_s = float(val)
             if name == "continual_max_updates":
                 self.max_updates = int(val)
+            if name == "continual_index_rows":
+                self.index_rows = int(val)
         if self.generations < 1:
             raise ValueError("continual_generations must be >= 1")
         if self.export_every < 1:
@@ -167,10 +178,21 @@ class GenerationExporter:
         self._mon = monitor
         self.engine = None
         self.compiled_programs = 0       # gen-1 warmup compiles
+        self.index_metric = "dot"
+        for name, val in self.cfg:
+            if name == "index_metric":
+                self.index_metric = val
 
-    def export(self, snapshot: str, out: str) -> Dict[str, Any]:
+    def export(self, snapshot: str, out: str,
+               corpus: Optional[np.ndarray] = None) -> Dict[str, Any]:
         """Seal ``snapshot`` into a committed bundle at ``out``;
-        returns the ``export`` record fields."""
+        returns the ``export`` record fields. With a ``corpus`` (raw
+        host rows), re-embed it through THIS generation's weights and
+        seal the rebuilt index into the same bundle — the search
+        programs land in the shared registry under the same
+        ``search_sig`` keys, so generation 1 pays their compiles once
+        and every later rebuild re-seals the family with zero new
+        compiles."""
         if self.engine is None:
             engine = build_engine(
                 self.cfg, snapshot, buckets=self.sc.buckets,
@@ -185,8 +207,37 @@ class GenerationExporter:
             self.engine = engine
         else:
             self.engine.trainer.load_weights_inplace(snapshot)
+        retrieval = None
+        if corpus is not None and corpus.shape[0] > 0:
+            retrieval = self._build_retrieval(corpus, out)
         return export_bundle(self.engine, out, node=self.sc.node,
-                             monitor=self._mon)
+                             monitor=self._mon, retrieval=retrieval)
+
+    def _build_retrieval(self, corpus: np.ndarray, out: str):
+        from ..retrieval import EmbeddingIndex, RetrievalEngine
+        t0 = time.time()
+        vecs = np.asarray(self.engine.run(corpus), np.float32)
+        index = EmbeddingIndex.build(
+            ids=np.arange(corpus.shape[0], dtype=np.int64),
+            vectors=vecs.reshape(corpus.shape[0], -1),
+            metric=self.index_metric, node=self.sc.node)
+        spec = self.sc.search_buckets
+        buckets = tuple(sorted({int(t) for t in spec.split(",")
+                                if t.strip()})) \
+            if spec and spec != "auto" else None
+        r = RetrievalEngine(index, self.engine.trainer.programs,
+                            k=self.sc.search_k or 10,
+                            buckets=buckets, monitor=self._mon)
+        budget = int(
+            self.engine.trainer.serve_device_mem_budget * 1e6)
+        r.warmup(warm_run=False, budget_bytes=budget)
+        if self._mon is not None and self._mon.enabled:
+            self._mon.emit(
+                "index_build", out=out, rows=index.rows,
+                dim=index.dim, metric=index.metric,
+                node=self.sc.node, bytes=index.nbytes,
+                wall_ms=(time.time() - t0) * 1e3)
+        return r
 
 
 class ContinualLoop:
@@ -231,6 +282,11 @@ class ContinualLoop:
         self.fleet: Optional[FleetServer] = None
         self.exporter = GenerationExporter(self.cfg, monitor=monitor)
         self._round = 0
+        # retrieval corpus capture (continual_index_rows): RAW rows,
+        # not embeddings — every generation re-embeds them through its
+        # own weights so the sealed index always matches the bundle
+        self._corpus_parts: List[np.ndarray] = []
+        self._corpus_got = 0
         # (model_id, router generation) -> last observed post-warmup
         # compile count of that engine: each engine contributes its
         # LAST observation exactly once to the loop total, however
@@ -294,6 +350,30 @@ class ContinualLoop:
                        wall_s=t.last_round_wall_s,
                        examples_per_sec=t.last_round_examples_per_sec)
             self._round += 1
+
+    def _capture_corpus(self, stream):
+        """Tee the first ``continual_index_rows`` valid training rows
+        off the batch stream as the retrieval corpus (host copies —
+        the iterator/transform may hand back recycled or device
+        arrays)."""
+        want = self.cc.index_rows
+        for batch in stream:
+            if self._corpus_got < want:
+                n = min(batch.batch_size - batch.num_batch_padd,
+                        want - self._corpus_got)
+                if n > 0:
+                    self._corpus_parts.append(np.array(
+                        np.asarray(batch.data)[:n], np.float32))
+                    self._corpus_got += n
+            yield batch
+
+    def _corpus_rows(self) -> Optional[np.ndarray]:
+        if not self._corpus_parts:
+            return None
+        if len(self._corpus_parts) > 1:
+            self._corpus_parts = [
+                np.concatenate(self._corpus_parts, axis=0)]
+        return self._corpus_parts[0]
 
     def _train_until(self, stream, target_updates: int) -> bool:
         """Advance the trainer to ``target_updates`` applied updates in
@@ -417,7 +497,8 @@ class ContinualLoop:
         snap = self.path_for(counter)
         out = default_bundle_path(snap)
         try:
-            stats = self.exporter.export(snap, out)
+            stats = self.exporter.export(snap, out,
+                                         corpus=self._corpus_rows())
         except Exception as e:
             # failing to *upgrade* must never take down what works:
             # warn, keep serving, keep training (the committed
@@ -493,6 +574,8 @@ class ContinualLoop:
         best: Optional[float] = None
         preempted = False
         stream = self._stream()
+        if cc.index_rows > 0:
+            stream = self._capture_corpus(stream)
         self._ckpt = CheckpointManager(
             self.trainer, self.path_for, model_dir=self.model_dir,
             monitor=self._mon, **self._ckpt_kw)

@@ -50,6 +50,13 @@ PROGRAM_BUILDERS = {
     "cxxnet_tpu/parallel/gradsync.py": (
         "measure_step_breakdown",
     ),
+    # the retrieval top-k program family (doc/retrieval.md): one lower
+    # site per query bucket, keyed by search_sig in the SAME registry
+    # as the predict programs — sealed into bundles and installed at
+    # boot, so a served /v1/search never reaches this builder
+    "cxxnet_tpu/retrieval/engine.py": (
+        "RetrievalEngine._lower_search",
+    ),
 }
 
 # -- CXL003: hot-path roots -----------------------------------------------

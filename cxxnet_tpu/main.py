@@ -51,7 +51,7 @@ EXIT_PREEMPTED = 75
 # quantize rides here too — calibration wants the deterministic eval
 # transform, not the shuffled/augmented training stream
 _PRED_TASKS = ("pred", "extract_feature", "extract", "pred_raw", "serve",
-               "quantize")
+               "quantize", "build_index")
 
 # randomized-pipeline knobs neutralized when a pred-like task falls
 # back to the train data block: evaluation order must be the file
@@ -120,6 +120,11 @@ class LearnTask:
         # output bundle directory; "" derives NNNN.model.bundle beside
         # model_in so a watched model_dir picks the bundle up
         self.export_out = ""
+        # embedding index build (task = build_index, doc/retrieval.md):
+        # similarity metric sealed into the index, and a corpus-size
+        # cap (0 = embed the whole iterator)
+        self.index_metric = "dot"
+        self.index_rows = 0
         # finetune remap contract (doc/tasks.md "finetune"): layers
         # named here re-initialize fresh (the new-label-count head);
         # any OTHER shape mismatch is a typed FinetuneShapeError
@@ -222,6 +227,10 @@ class LearnTask:
             self.quantize_out = val
         if name == "export_out":
             self.export_out = val
+        if name == "index_metric":
+            self.index_metric = val
+        if name == "index_rows":
+            self.index_rows = int(val)
         if name == "finetune_remap":
             self.finetune_remap = tuple(
                 t.strip() for t in val.split(",") if t.strip())
@@ -460,6 +469,12 @@ class LearnTask:
             if self.task == "quantize":
                 assert self.model_in, "task quantize requires model_in"
                 return self._task_quantize(cfg, pred_iter or itr_train)
+
+            if self.task == "build_index":
+                assert self.model_in, \
+                    "task build_index requires model_in"
+                return self._task_build_index(cfg,
+                                              pred_iter or itr_train)
 
             trainer = NetTrainer(cfg)
             if self.task in ("train", "finetune", "continual"):
@@ -1219,6 +1234,86 @@ class LearnTask:
                     stats["bytes"]))
         if mon.enabled:
             mon.emit("task_end", task="export", outfile=out)
+        return 0
+
+    def _task_build_index(self, cfg, itr) -> int:
+        """Embed the iterator's corpus through the frozen serve net
+        and seal model + index as ONE deployable bundle
+        (doc/retrieval.md): stream valid rows through the bucketed
+        engine (the exact dispatch ``/v1/embed`` serves), build the
+        exact top-k index over the embeddings, AOT-compile the search
+        program family into the same registry, and commit everything
+        as a digest-verified artifact. A replica booting from the
+        bundle serves ``/v1/embed`` and ``/v1/search`` with zero
+        compiles, and a hot-swap flips model and index atomically."""
+        assert itr is not None, "build_index requires an iterator block"
+        assert world_size() == 1, \
+            "task=build_index must run single-process"
+        from .artifact.bundle import default_bundle_path, export_bundle
+        from .retrieval import (EmbeddingIndex, RetrievalEngine,
+                                self_recall)
+        from .serve import ServeConfig, build_engine
+        mon = self._mon
+        t_start = time.time()
+        if mon.enabled:
+            mon.emit("run_start",
+                     **run_metadata("build_index", self._cfg_stream))
+        sc = ServeConfig(cfg)
+        engine = build_engine(cfg, self.model_in, buckets=sc.buckets,
+                              max_batch=sc.max_batch, node=sc.node,
+                              monitor=mon)
+        compiled = engine.warmup(warm_run=False)
+        # corpus pass: valid rows only, private copies (iterator ring
+        # buffers recycle their arrays), capped by index_rows
+        parts, got, cap = [], 0, self.index_rows
+        for batch in itr:
+            n = batch.batch_size - batch.num_batch_padd
+            if cap and got + n > cap:
+                n = cap - got
+            if n > 0:
+                parts.append(np.array(batch.data[:n], np.float32))
+                got += n
+            if cap and got >= cap:
+                break
+        assert parts, "build_index: iterator produced no examples"
+        rows = np.concatenate(parts, axis=0)
+        vecs = np.asarray(engine.run(rows), np.float32)
+        index = EmbeddingIndex.build(
+            ids=np.arange(rows.shape[0], dtype=np.int64),
+            vectors=vecs.reshape(rows.shape[0], -1),
+            metric=self.index_metric, node=sc.node)
+        spec = sc.search_buckets
+        buckets = tuple(sorted({int(t) for t in spec.split(",")
+                                if t.strip()})) \
+            if spec and spec != "auto" else None
+        rengine = RetrievalEngine(index, engine.trainer.programs,
+                                  k=sc.search_k or 10,
+                                  buckets=buckets, monitor=mon)
+        budget = int(engine.trainer.serve_device_mem_budget * 1e6)
+        rengine.warmup(warm_run=False, budget_bytes=budget)
+        t_rec = time.time()
+        rec = self_recall(rengine)
+        if mon.enabled:
+            mon.emit("retrieval", queries=min(8, index.rows), k=1,
+                     metric=index.metric, recall=rec,
+                     wall_ms=(time.time() - t_rec) * 1e3)
+        out = self.export_out or default_bundle_path(self.model_in)
+        stats = export_bundle(engine, out, node=sc.node, monitor=mon,
+                              retrieval=rengine)
+        if mon.enabled:
+            mon.emit("index_build", out=out, rows=index.rows,
+                     dim=index.dim, metric=index.metric, node=sc.node,
+                     bytes=index.nbytes,
+                     wall_ms=(time.time() - t_start) * 1e3)
+            mon.emit("export", **stats)
+        mon.line(
+            "build_index: %d rows x %d dims (%s) sealed with %s -> %s "
+            "(self-recall@1 %.3f, %d+%d programs, %d index bytes)"
+            % (index.rows, index.dim, index.metric, self.model_in,
+               out, rec, compiled, len(rengine.buckets), index.nbytes))
+        if mon.enabled:
+            mon.emit("task_end", task="build_index", outfile=out,
+                     rows=index.rows)
         return 0
 
     def _task_predict(self, trainer, itr) -> int:

@@ -178,6 +178,14 @@ REQUIRED: Dict[str, tuple] = {
                    "value", "train_updates", "path", "wall_ms"),
     "continual": ("generations", "deployed", "gate_skipped",
                   "updates", "swaps", "wall_s"),
+    # embedding retrieval (doc/retrieval.md): the task=build_index
+    # rollup (corpus shape, metric, source node, sealed bytes), and
+    # the engine-vs-oracle spot check — "recall" is the fraction of
+    # probe queries whose exact top-1 matched (1.0 for a healthy
+    # exact index)
+    "index_build": ("out", "rows", "dim", "metric", "node", "bytes",
+                    "wall_ms"),
+    "retrieval": ("queries", "k", "metric", "recall", "wall_ms"),
 }
 
 _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",
@@ -192,7 +200,7 @@ _TIMING_KEYS = ("wall_ms", "data_wait_ms", "total_ms", "max_ms",
 # ratio fields must sit in [0, 1]
 _RATIO_KEYS = ("buffer_reuse_rate", "h2d_overlap_ratio", "fill_rate",
                "pad_fraction", "agree_rate", "data_wait_share",
-               "overlap_ratio")
+               "overlap_ratio", "recall")
 
 
 def validate_record(rec: Dict[str, Any]) -> List[str]:

@@ -1,0 +1,250 @@
+"""The device-resident exact top-k search engine.
+
+Pairs an :class:`~cxxnet_tpu.retrieval.index.EmbeddingIndex` with the
+model's :class:`~cxxnet_tpu.artifact.registry.ProgramRegistry`: the
+corpus matrix is pushed to device once at warmup, and one AOT search
+program per query-count bucket scores every corpus row and takes
+``jax.lax.top_k`` — exact retrieval, no recall knob on the engine
+itself.
+
+The registry is *shared with the trainer's pred programs* on purpose:
+
+- the search executables serialize into the sealed bundle through the
+  exact same ``serialize_programs`` path as the pred ladder, so a
+  bundle boot installs them and search warms with **zero compiles**;
+- the corpus is a program *argument* (see
+  ``artifact.registry.search_sig``), not a closure constant — the
+  executable is corpus-independent up to shape, which is what makes it
+  serializable and lets the continual loop swap a re-embedded corpus
+  of the same shape without touching the program family;
+- index bytes ride the same ``serve_device_mem_budget`` books as the
+  frozen weight tree: warmup adds ``index.nbytes`` on top of the
+  registry's weight residency and raises the same typed
+  :class:`~cxxnet_tpu.artifact.registry.ResidencyBudgetError` on a
+  breach — a rejection, not a device OOM mid-request.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..artifact.registry import (ProgramRegistry, ResidencyBudgetError,
+                                 search_sig)
+from ..serve.bucketing import bucket_ladder, pick_bucket
+from .index import EmbeddingIndex
+
+# default result depth compiled into the program family
+# (``search_k``); requests may ask for any k <= this (host slice)
+DEFAULT_K = 10
+
+
+class RetrievalEngine:
+    """Bucketed AOT top-k search over one embedding index.
+
+    ``registry`` is the owning model's program registry
+    (``trainer.programs``) so search and pred executables live in one
+    compile/serialize/install ledger. Thread safety mirrors
+    :class:`~cxxnet_tpu.serve.engine.InferenceEngine`: program lookup
+    and counters under one lock, the D2H materialization outside it.
+    """
+
+    def __init__(self, index: EmbeddingIndex,
+                 registry: ProgramRegistry,
+                 k: int = DEFAULT_K,
+                 buckets: Optional[Sequence[int]] = None,
+                 monitor=None):
+        if index.rows < 1:
+            raise ValueError("cannot serve an empty index")
+        self.index = index
+        self.registry = registry
+        # k is a static program dimension; cap at the corpus (top_k
+        # of more rows than exist is a compile error, not a result)
+        self.k = max(1, min(int(k), index.rows))
+        if buckets is None:
+            buckets = bucket_ladder(32)
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        if self.buckets[0] < 1:
+            raise ValueError("query buckets must be >= 1")
+        self.max_batch = self.buckets[-1]
+        self._mon = monitor
+        self._lock = threading.Lock()
+        self._sigs = set()
+        self._corpus = None              # device corpus (set at warmup)
+        self._fallback = None            # jit path for uncompiled keys
+        self.counters: Dict[str, int] = {
+            "dispatches": 0, "queries": 0, "pad_rows": 0,
+            "aot_hits": 0, "compile_events": 0}
+
+    # -- keys -------------------------------------------------------------
+
+    def _key(self, bucket: int) -> tuple:
+        return ("search",) + search_sig(
+            bucket, self.index.dim, self.index.rows, self.k,
+            self.index.metric, "float32")
+
+    # -- program construction ---------------------------------------------
+
+    def _make_fn(self):
+        """The traced search program: cast + (cosine) query-normalize +
+        one matmul + ``lax.top_k``. The corpus is an argument; metric
+        and k are static (they live in the key)."""
+        import jax
+        import jax.numpy as jnp
+        cosine = self.index.metric == "cosine"
+        k = self.k
+
+        def fn(q, corpus):
+            q = q.astype(jnp.float32)
+            if cosine:
+                norm = jnp.linalg.norm(q, axis=1, keepdims=True)
+                q = q / jnp.maximum(norm, 1e-12)
+            scores = q @ corpus.T
+            return jax.lax.top_k(scores, k)
+        return fn
+
+    def _lower_search(self, bucket: Optional[int]):
+        """The ONE jit/lower call site of the retrieval subsystem
+        (registered in ``lint.config.PROGRAM_BUILDERS``): returns the
+        lowered program for a query bucket, or — with ``bucket=None``
+        — the jitted fallback for keys whose AOT compile failed."""
+        import jax
+        jitted = jax.jit(self._make_fn())
+        if bucket is None:
+            return jitted
+        q_spec = jax.ShapeDtypeStruct(
+            (int(bucket), self.index.dim), np.float32)
+        c_spec = jax.ShapeDtypeStruct(
+            (self.index.rows, self.index.dim), np.float32)
+        return jitted.lower(q_spec, c_spec)
+
+    # -- warmup -----------------------------------------------------------
+
+    def warmup(self, warm_run: bool = True,
+               budget_bytes: int = 0) -> int:
+        """Push the corpus to device, enforce the residency budget
+        (weights + index against ``serve_device_mem_budget``), compile
+        the bucket family through the shared registry (keys a bundle
+        already installed are skipped — the zero-compile boot), and
+        optionally warm-run each bucket. Returns the number of programs
+        newly compiled; counters reset afterwards."""
+        res = self.registry.residency
+        weight_bytes = res.total_bytes if res is not None else 0
+        total = weight_bytes + self.index.nbytes
+        if budget_bytes and total > budget_bytes:
+            raise ResidencyBudgetError(
+                "weights (%d bytes) + embedding index (%d bytes) need "
+                "%d resident bytes but serve_device_mem_budget allows "
+                "%d" % (weight_bytes, self.index.nbytes, total,
+                        budget_bytes))
+        import jax
+        self._corpus = jax.device_put(self.index.vectors)
+        programs = [(self._key(b), lambda b=b: self._lower_search(b))
+                    for b in self.buckets]
+        compiled = self.registry.compile(
+            programs, "precompile_search_failed", self._mon)
+        if warm_run:
+            for b in self.buckets:
+                self.search(np.zeros((b, self.index.dim), np.float32))
+        with self._lock:
+            for c in self.counters:
+                self.counters[c] = 0
+        return compiled
+
+    # -- search -----------------------------------------------------------
+
+    def search(self, queries: np.ndarray,
+               k: Optional[int] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact top-k over the corpus: ``(ids, scores)`` with shapes
+        ``(n, k)``. ``k`` defaults to the compiled depth and may be any
+        value ``1..self.k`` (a host slice — no new program); a larger k
+        is a request error because it would compile in the hot path."""
+        if self._corpus is None:
+            raise RuntimeError("RetrievalEngine.warmup() not called")
+        want = self.k if k is None else int(k)
+        if not 1 <= want <= self.k:
+            raise ValueError(
+                "k=%d outside the served range 1..%d (search_k pins "
+                "the compiled result depth)" % (want, self.k))
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        if q.ndim != 2 or q.shape[1] != self.index.dim:
+            raise ValueError(
+                "query shape %r does not match the index dim %d"
+                % (np.shape(queries), self.index.dim))
+        if q.shape[0] < 1:
+            raise ValueError("search() needs at least one query row")
+        ids_out, sc_out = [], []
+        for i in range(0, q.shape[0], self.max_batch):
+            ids, sc = self._dispatch(q[i:i + self.max_batch], want)
+            ids_out.append(ids)
+            sc_out.append(sc)
+        if len(ids_out) == 1:
+            return ids_out[0], sc_out[0]
+        return (np.concatenate(ids_out, axis=0),
+                np.concatenate(sc_out, axis=0))
+
+    def _dispatch(self, q: np.ndarray, want: int
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        n = q.shape[0]
+        bucket = pick_bucket(n, self.buckets)
+        if n < bucket:
+            pad = np.zeros((bucket - n, q.shape[1]), np.float32)
+            q = np.concatenate([q, pad], axis=0)
+        key = self._key(bucket)
+        with self._lock:
+            exe = self.registry.get(key)
+            if exe is not None:
+                self.counters["aot_hits"] += 1
+            elif key not in self._sigs:
+                self._sigs.add(key)
+                self.counters["compile_events"] += 1
+            if exe is None and self._fallback is None:
+                self._fallback = self._lower_search(None)
+            fn = exe if exe is not None else self._fallback
+            vals = fn(q, self._corpus)
+        # D2H outside the lock (the expensive wait; no shared state)
+        scores = np.asarray(vals[0])
+        rowidx = np.asarray(vals[1])
+        with self._lock:
+            self.counters["dispatches"] += 1
+            self.counters["queries"] += n
+            self.counters["pad_rows"] += bucket - n
+        ids = self.index.ids[rowidx[:n, :want]]
+        return ids, scores[:n, :want].astype(np.float32)
+
+    # -- embedding-side helpers -------------------------------------------
+
+    def embed_queries(self, vectors: np.ndarray) -> np.ndarray:
+        """Canonicalize raw query embeddings the way the program will
+        see them (float32; cosine normalization happens on device)."""
+        q = np.asarray(vectors, np.float32)
+        return q if q.ndim == 2 else q.reshape(1, -1)
+
+    def counters_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counters)
+
+    def describe(self) -> Dict[str, object]:
+        snap = self.counters_snapshot()
+        snap.update({"rows": self.index.rows, "dim": self.index.dim,
+                     "metric": self.index.metric, "k": self.k,
+                     "index_bytes": self.index.nbytes,
+                     "buckets": list(self.buckets)})
+        return snap
+
+
+def self_recall(engine: RetrievalEngine, sample: int = 8) -> float:
+    """Spot-check recall: query the index with its own first ``sample``
+    corpus rows — each must retrieve itself at rank 1 (exact search,
+    duplicate-free corpus). Returns the hit fraction; the
+    ``retrieval`` telemetry record's ``recall`` field."""
+    n = min(int(sample), engine.index.rows)
+    q = engine.index.vectors[:n]
+    ids, _ = engine.search(q, k=1)
+    hits = int(np.sum(ids[:, 0] == engine.index.ids[:n]))
+    return hits / float(n)

@@ -119,6 +119,50 @@ HTTP_STATUS = {
     "error": 500,
 }
 
+# served operations beyond plain prediction (doc/retrieval.md): a
+# request names ``model#op[:k]`` — ``embed`` (the served node's
+# vectors; identical dispatch to predict, named for intent), ``search``
+# (rows are query VECTORS, top-k over the model's sealed index) and
+# ``fsearch`` (rows are model INPUTS; embed -> search composed in one
+# request on ONE resolved model entry — the fan_out=1 form of
+# /v1/search). The suffix rides the existing model-id field on both
+# protocols, so the binary wire needs no new frame grammar.
+SERVE_OPS = ("embed", "search", "fsearch")
+
+
+def parse_model_op(model_id: str) -> Tuple[str, str, Optional[int]]:
+    """Split ``model#op[:k]`` -> (model, op, k). Plain ids pass
+    through as (id, "", None); an unknown op or malformed k raises
+    ValueError (-> bad_request)."""
+    base, sep, op = model_id.partition("#")
+    if not sep:
+        return model_id, "", None
+    op, ksep, kstr = op.partition(":")
+    if op not in SERVE_OPS:
+        raise ValueError("unknown serve op %r (one of %s)"
+                         % (op, "/".join(SERVE_OPS)))
+    k = None
+    if ksep:
+        k = int(kstr)                    # ValueError -> bad_request
+        if k < 1:
+            raise ValueError("search k must be >= 1, got %d" % k)
+    return base, op, k
+
+
+def pack_search_result(ids: np.ndarray, scores: np.ndarray
+                       ) -> Tuple[np.ndarray, Dict[str, Any]]:
+    """One wire form of a top-k answer for both protocols: the result
+    rows are the (n, 2k) float32 block ``[ids | scores]`` (the binary
+    reply ships it verbatim; doc ids are exact in float32 up to 2**24
+    corpus rows — doc/retrieval.md) and the extra dict carries the
+    JSON lists the HTTP handler answers with."""
+    payload = np.concatenate(
+        [ids.astype(np.float32), scores.astype(np.float32)], axis=1)
+    extra = {"k": int(ids.shape[1]),
+             "ids": ids.tolist(),  # cxxlint: disable=CXL003 -- host arrays already (post-D2H); JSON reply staging
+             "scores": scores.tolist()}  # cxxlint: disable=CXL003 -- host arrays already (post-D2H); JSON reply staging
+    return payload, extra
+
 
 def pack_request(model: str, tenant: str, rows: np.ndarray,
                  timeout_ms: float = 0.0) -> bytes:
@@ -658,9 +702,18 @@ class FleetServer:
         nrows = 0
         resolved = model_id
         try:
-            entry = self.router.resolve(model_id)
+            base, op, k = parse_model_op(model_id)
+            resolved = base
+            entry = self.router.resolve(base)
             resolved = entry.model_id
-            arr = self._shape_rows(entry, rows)
+            if op in ("search", "fsearch") \
+                    and entry.session.retrieval is None:
+                raise ValueError("model %r serves no embedding index"
+                                 % resolved)
+            if op == "search":
+                arr = self._shape_queries(entry, rows)
+            else:
+                arr = self._shape_rows(entry, rows)
             nrows = arr.shape[0]
             try:
                 self.quota.admit(tenant, nrows)
@@ -670,8 +723,20 @@ class FleetServer:
                            burst=e.burst,
                            retry_after_s=round(e.retry_after_s, 3))
                 raise
-            out = self._predict_with_retry(resolved, arr, timeout_ms)
-            status, result, extra = "ok", out, {}
+            if op == "search":
+                out, extra = pack_search_result(
+                    *self._search_current(resolved, arr, k))
+            elif op == "fsearch":
+                out, extra = pack_search_result(
+                    *self._fanout_with_retry(resolved, arr, k,
+                                             timeout_ms))
+            else:
+                # "" and "embed" are the same dispatch: the served
+                # node's per-row vectors through the batcher
+                out = self._predict_with_retry(resolved, arr,
+                                               timeout_ms)
+                extra = {}
+            status, result = "ok", out
         except TenantQuotaError as e:
             status, result = "over_quota", str(e)
             extra = {"retry_after_s": e.retry_after_s}
@@ -708,6 +773,21 @@ class FleetServer:
                 % (tuple(arr.shape), inst, elems))
         return arr
 
+    def _shape_queries(self, entry, rows) -> np.ndarray:
+        """``#search`` rows are query VECTORS in the index's embedding
+        space (not model inputs): coerce to (n, dim) against the
+        served index; mismatches bounce as bad_request."""
+        r = entry.session.retrieval
+        arr = np.asarray(rows, dtype=np.float32)  # cxxlint: disable=CXL003 -- protocol admission: query vectors arrive as host bytes/JSON
+        dim = r.index.dim
+        if arr.ndim == 1 and arr.size == dim:
+            arr = arr.reshape(1, dim)
+        if arr.ndim != 2 or arr.shape[1] != dim:
+            raise ValueError(
+                "queries of shape %r do not match the index embedding "
+                "dim %d" % (tuple(arr.shape), dim))
+        return arr
+
     def handle_async(self, model_id: str, tenant: str, rows,
                      protocol: str = "binary",
                      timeout_ms: Optional[float] = None,
@@ -719,6 +799,19 @@ class FleetServer:
         ``done(status, result, extra)`` fires exactly once — inline
         for admission failures, from a serve worker thread otherwise
         — and, like ``handle``, this never raises."""
+        if "#" in model_id:
+            # retrieval ops (``model#op[:k]``) answer through the
+            # synchronous core: search dispatches outside the batcher
+            # and fsearch must hold ONE resolved entry across both
+            # legs (the no-torn-pair guarantee), so neither rides a
+            # batcher Future. handle() records the request itself, so
+            # ``done`` fires directly — the one v2 tradeoff is that
+            # these replies come in handler-thread completion order.
+            status, result, extra = self.handle(
+                model_id, tenant, rows, protocol=protocol,
+                timeout_ms=timeout_ms)
+            done(status, result, extra)
+            return
         t0 = time.monotonic()
         state = {"nrows": 0, "model": model_id}
 
@@ -871,6 +964,51 @@ class FleetServer:
         raise ServeClosedError(
             "model %r kept draining across retries" % model_id)
 
+    def _search_current(self, model_id: str, arr: np.ndarray,
+                        k: Optional[int]
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+        """Top-k over the CURRENT entry's index. The router swaps
+        model and index as one entry, so one resolve is the whole
+        consistency story; the retrieval engine dispatches outside the
+        batcher and never raises ServeClosedError (its programs live
+        in the session's own registry, retired with it only after the
+        drain)."""
+        entry = self.router.resolve(model_id)
+        r = entry.session.retrieval
+        if r is None:        # raced a swap to an index-less bundle
+            raise ValueError("model %r serves no embedding index"
+                             % model_id)
+        return r.search(arr, k=k)
+
+    def _fanout_with_retry(self, model_id: str, arr: np.ndarray,
+                           k: Optional[int],
+                           timeout_ms: Optional[float]
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+        """``fan_out=1``: embed then search composed in ONE request on
+        ONE resolved entry — both legs run against the same session,
+        so a mid-flight hot-swap can never pair the new model with the
+        old index (or vice versa). The embed leg rides the batcher
+        (coalesced with plain predict traffic); a hot-swap
+        ServeClosedError retries the WHOLE composition through a fresh
+        resolve, exactly like :meth:`_predict_with_retry`."""
+        for _ in range(8):
+            entry = self.router.resolve(model_id)
+            r = entry.session.retrieval
+            if r is None:
+                raise ValueError("model %r serves no embedding index"
+                                 % model_id)
+            try:
+                vecs = entry.session.predict(arr, timeout_ms)
+            except ServeClosedError:
+                if self._closing:
+                    raise
+                time.sleep(0.001)
+                continue
+            vecs = np.asarray(vecs, dtype=np.float32)  # cxxlint: disable=CXL003 -- batcher results are already host rows
+            return r.search(vecs.reshape(vecs.shape[0], -1), k=k)
+        raise ServeClosedError(
+            "model %r kept draining across retries" % model_id)
+
     # -- telemetry / accounting -------------------------------------------
 
     def _emit(self, kind: str, **fields) -> None:
@@ -931,6 +1069,12 @@ class FleetServer:
                 "fingerprint_sha256": self._fingerprint_sha(
                     e.session.engine.trainer.mesh),
             })
+            r = e.session.retrieval
+            if r is not None:
+                # the search contract clients compose against
+                # (doc/retrieval.md): what /v1/search accepts and what
+                # k it answers by default
+                out[-1]["index"] = r.describe()
         return out
 
     def health_snapshot(self) -> Dict[str, Any]:
@@ -965,6 +1109,13 @@ class FleetServer:
                 "compile_events": snap["compile_events"],
                 "aot_hits": snap["aot_hits"],
             }
+            r = e.session.retrieval
+            if r is not None:
+                # search has its own compile books: the zero-compile
+                # guarantee covers predict AND search dispatch
+                rsnap = r.counters_snapshot()
+                row["search_compile_events"] = rsnap["compile_events"]
+                row["search_aot_hits"] = rsnap["aot_hits"]
             # cumulative batch economics (fill/pad): what the fleet
             # bench aggregates across replicas (doc/serving.md "Fleet
             # data path")
@@ -1098,9 +1249,11 @@ class _HttpHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         fleet = self.server.fleet
-        if self.path != "/v1/predict":
+        if self.path not in ("/v1/predict", "/v1/embed",
+                             "/v1/search"):
             self._send_json(404, {"error": "not_found",
-                                  "message": "POST /v1/predict"})
+                                  "message": "POST /v1/predict, "
+                                  "/v1/embed or /v1/search"})
             return
         t0 = time.monotonic()
         try:
@@ -1110,6 +1263,17 @@ class _HttpHandler(BaseHTTPRequestHandler):
             tenant = str(req.get("tenant", ""))
             timeout_ms = req.get("timeout_ms")
             rows = req["rows"]
+            # the endpoints are sugar over the op-suffix grammar the
+            # shared core (and the binary protocol) speak natively
+            op_model = model
+            if self.path == "/v1/embed":
+                op_model = model + "#embed"
+            elif self.path == "/v1/search":
+                op = "fsearch" if int(req.get("fan_out", 0) or 0) \
+                    else "search"
+                k = req.get("k")
+                op_model = model + "#" + op + \
+                    (":%d" % int(k) if k is not None else "")
         except (ValueError, KeyError, TypeError) as e:
             # malformed body: never reached the shared core, so the
             # request is recorded here for the stream's completeness
@@ -1119,9 +1283,15 @@ class _HttpHandler(BaseHTTPRequestHandler):
                                   "'rows': %s" % e})
             return
         status, result, extra = fleet.handle(
-            model, tenant, rows, protocol="http",
+            op_model, tenant, rows, protocol="http",
             timeout_ms=timeout_ms)
         code = HTTP_STATUS[status]
+        if status == "ok" and "ids" in extra:
+            self._send_json(code, {
+                "model": model or fleet.router.default_id,
+                "rows": len(extra["ids"]), "k": extra["k"],
+                "ids": extra["ids"], "scores": extra["scores"]})
+            return
         if status == "ok":
             flat = np.asarray(result)
             self._send_json(code, {

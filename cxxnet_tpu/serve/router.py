@@ -31,14 +31,18 @@ class UnknownModelError(KeyError):
 
 
 def session_resident_bytes(session) -> int:
-    """A session's device-resident weight bytes (the model's frozen
-    serve weight tree + retained masters, buffer-deduplicated) — 0
-    when the engine runs without weight residency accounting."""
+    """A session's device-resident bytes: the model's frozen serve
+    weight tree + retained masters (buffer-deduplicated) plus — when
+    the bundle sealed an embedding index — the device corpus matrix.
+    0 when the engine runs without weight residency accounting (the
+    index still counts: it is resident regardless)."""
+    idx_bytes = int(getattr(session, "index_bytes", 0) or 0)
     try:
         res = session.engine.trainer.programs.residency
     except AttributeError:
-        return 0
-    return int(res.total_bytes) if res is not None else 0
+        return idx_bytes
+    weight = int(res.total_bytes) if res is not None else 0
+    return weight + idx_bytes
 
 
 class ModelEntry:

@@ -49,6 +49,11 @@ class ServeConfig:
         self.clients = 8
         self.requests = 32
         self.request_rows = 1
+        # retrieval overrides (doc/retrieval.md): 0/"" defer to the
+        # bundle's sealed search contract, so a plain boot requests
+        # exactly the sealed search keys (zero compiles)
+        self.search_k = 0
+        self.search_buckets = ""
         batch_size = 0
         for name, val in cfg:
             if name == "batch_size":
@@ -73,6 +78,10 @@ class ServeConfig:
                 self.requests = int(val)
             if name == "serve_request_rows":
                 self.request_rows = int(val)
+            if name == "search_k":
+                self.search_k = int(val)
+            if name == "search_buckets":
+                self.search_buckets = val
         if not self.max_batch:
             self.max_batch = batch_size
         if not self.max_batch:
@@ -102,6 +111,15 @@ class ServeSession:
                                   monitor=monitor)
         self.engine = engine
         self.warmup_programs = engine.warmup(warm_run=bool(c.warm_run))
+        # a bundle that seals an embedding index gets a retrieval
+        # engine beside the predictor: same program registry (search
+        # executables install from the bundle → zero-compile search
+        # warmup), same residency budget books (weights + index), one
+        # atomic swap unit
+        self.retrieval = None
+        self.index_bytes = 0
+        if model_path:
+            self._attach_index(model_path, monitor)
         self.batcher = DynamicBatcher(
             engine.stage, engine.dispatch,
             max_batch=engine.max_batch, max_delay_ms=c.max_delay_ms,
@@ -109,6 +127,42 @@ class ServeSession:
             monitor=monitor, row_shape=engine._inst_shape(),
             extra_summary=self._engine_summary)
         self._closed = False
+
+    def _attach_index(self, model_path: str, monitor) -> None:
+        """Load the bundle's sealed index (digest-verified) into a
+        warmed :class:`~cxxnet_tpu.retrieval.engine.RetrievalEngine`.
+        No-op for snapshot models and index-less bundles. Explicit
+        ``search_k`` / ``search_buckets`` config wins over the sealed
+        contract (those keys then re-lower instead of installing)."""
+        from ..artifact import bundle as _ab
+        if not _ab.is_bundle(model_path):
+            return
+        man = _ab.bundle_manifest(model_path)
+        entry = man.get("index")
+        if entry is None:
+            return
+        from ..retrieval import EmbeddingIndex, RetrievalEngine
+        index = EmbeddingIndex.deserialize(
+            _ab.read_index_member(model_path, man))
+        c = self.cfg
+        spec = c.search_buckets
+        if spec and spec != "auto":
+            buckets = tuple(sorted({int(t) for t in spec.split(",")
+                                    if t.strip()}))
+        elif spec != "auto" and entry.get("buckets"):
+            buckets = tuple(int(b) for b in entry["buckets"])
+        else:
+            buckets = None               # the engine's default ladder
+        self.retrieval = RetrievalEngine(
+            index, self.engine.trainer.programs,
+            k=c.search_k or int(entry.get("k", 0)) or 10,
+            buckets=buckets, monitor=monitor)
+        # the same budget the weight tree froze under: index bytes
+        # stack on top of the registry's weight residency
+        budget = int(self.engine.trainer.serve_device_mem_budget * 1e6)
+        self.retrieval.warmup(warm_run=bool(c.warm_run),
+                              budget_bytes=budget)
+        self.index_bytes = index.nbytes
 
     def _engine_summary(self) -> Dict[str, int]:
         # one snapshot: compile_events and aot_hits must come from the

@@ -132,6 +132,76 @@ class MetricRecall(Metric):
             / label.shape[1]
 
 
+def _topk_by_index(pred: np.ndarray, k: int) -> np.ndarray:
+    """Deterministic top-k prediction columns: scores descending,
+    ties broken by LOWEST column index — the same order
+    ``jax.lax.top_k`` and ``retrieval.oracle_topk`` report, so a
+    metric computed over served search results and one computed over
+    raw scores agree exactly even with duplicate scores."""
+    order = np.argsort(-pred, axis=1, kind="stable")
+    return order[:, :k]
+
+
+class MetricRecallAtK(Metric):
+    """recall@k: |relevant ∩ top-k| / |relevant| per row.
+
+    The retrieval-eval recall (doc/retrieval.md), distinct from the
+    reference's ``rec@n`` above in three deliberate ways: ``k`` clips
+    to the prediction width (k > corpus is a defined query, not an
+    error), negative label entries are padding (multi-label rows of
+    different lengths share one label matrix), and a row with zero
+    valid labels scores 0 while still counting — an all-pad eval
+    stream reads as 0 recall, not a crash."""
+
+    def __init__(self, name: str):
+        super().__init__()
+        self.name = name
+        if not name.startswith("recall@"):
+            raise ValueError("must specify k for recall@k")
+        self.topk = int(name[len("recall@"):])
+        if self.topk < 1:
+            raise ValueError("recall@k needs k >= 1, got %d"
+                             % self.topk)
+
+    def _calc(self, pred, label):
+        k = min(self.topk, pred.shape[1])
+        top = _topk_by_index(pred, k)
+        lab = label.astype(np.int64)
+        valid = lab >= 0
+        hits = (top[:, :, None] == lab[:, None, :]) & valid[:, None, :]
+        nrel = valid.sum(axis=1)
+        return np.where(
+            nrel > 0,
+            hits.any(axis=1).sum(axis=1) / np.maximum(nrel, 1),
+            0.0).astype(np.float32)
+
+
+class MetricPrecisionAtK(Metric):
+    """prec@k: |relevant ∩ top-k| / k per row — the multi-label
+    serving companion of recall@k. Same conventions: negative labels
+    are padding, k clips to the prediction width (the divisor stays
+    the requested k: asking for 10 of a 5-wide corpus caps precision
+    at 0.5 by construction), empty label rows score 0."""
+
+    def __init__(self, name: str):
+        super().__init__()
+        self.name = name
+        if not name.startswith("prec@"):
+            raise ValueError("must specify k for prec@k")
+        self.topk = int(name[len("prec@"):])
+        if self.topk < 1:
+            raise ValueError("prec@k needs k >= 1, got %d" % self.topk)
+
+    def _calc(self, pred, label):
+        k = min(self.topk, pred.shape[1])
+        top = _topk_by_index(pred, k)
+        lab = label.astype(np.int64)
+        valid = lab >= 0
+        hits = (top[:, :, None] == lab[:, None, :]) & valid[:, None, :]
+        return (hits.any(axis=2).sum(axis=1)
+                / float(self.topk)).astype(np.float32)
+
+
 def create_metric(name: str) -> Optional[Metric]:
     if name == "rmse":
         return MetricRMSE()
@@ -139,6 +209,10 @@ def create_metric(name: str) -> Optional[Metric]:
         return MetricError()
     if name == "logloss":
         return MetricLogloss()
+    if name.startswith("recall@"):
+        return MetricRecallAtK(name)
+    if name.startswith("prec@"):
+        return MetricPrecisionAtK(name)
     if name.startswith("rec@"):
         return MetricRecall(name)
     return None

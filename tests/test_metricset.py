@@ -88,3 +88,92 @@ def test_add_eval_length_mismatch_asserts():
     ms.add_metric("error")
     with pytest.raises(AssertionError):
         ms.add_eval([], {"label": np.zeros((1, 1), np.float32)})
+
+
+# -- recall@k / prec@k: the retrieval-eval pair (doc/retrieval.md) -------
+
+
+def test_recall_at_k_basic_and_padding():
+    m = create_metric("recall@2")
+    assert m.name == "recall@2"
+    # row 0: labels {1, 3}, top-2 = {1, 0} -> 1/2 recalled
+    # row 1: label {0} (pad -1 ignored), top-2 = {2, 1} -> 0 recalled
+    pred = np.array([[0.3, 0.9, 0.1, 0.2],
+                     [0.2, 0.3, 0.9, 0.1]], np.float32)
+    label = np.array([[1, 3], [0, -1]], np.float32)
+    np.testing.assert_allclose(m._calc(pred, label), [0.5, 0.0])
+
+
+def test_recall_at_k_clips_k_beyond_corpus():
+    """k > prediction width is a defined query (the legacy rec@n
+    raises): the whole corpus is the top-k, so every valid label is
+    recalled."""
+    m = create_metric("recall@10")
+    pred = np.array([[0.1, 0.9, 0.5]], np.float32)
+    label = np.array([[0, 2]], np.float32)
+    np.testing.assert_allclose(m._calc(pred, label), [1.0])
+
+
+def test_recall_at_k_empty_label_set_scores_zero():
+    """An all-pad label row scores 0 and still counts — not a crash,
+    not a dropped instance."""
+    m = create_metric("recall@2")
+    pred = np.array([[0.9, 0.1], [0.1, 0.9]], np.float32)
+    label = np.array([[-1, -1], [1, -1]], np.float32)
+    np.testing.assert_allclose(m._calc(pred, label), [0.0, 1.0])
+    m.add_eval(pred, label)
+    assert m.cnt_inst == 2 and m.get() == pytest.approx(0.5)
+
+
+def test_recall_at_k_duplicate_scores_tie_break_by_index():
+    """Tied scores break by LOWEST index — the same order
+    jax.lax.top_k and retrieval.oracle_topk report, so the metric
+    agrees with served search results bit-for-bit."""
+    m = create_metric("recall@2")
+    pred = np.array([[0.5, 0.5, 0.5, 0.5]], np.float32)
+    # top-2 of all-tied row = {0, 1}
+    np.testing.assert_allclose(
+        m._calc(pred, np.array([[1.0]], np.float32)), [1.0])
+    np.testing.assert_allclose(
+        m._calc(pred, np.array([[3.0]], np.float32)), [0.0])
+
+
+def test_prec_at_k_divisor_stays_requested_k():
+    m = create_metric("prec@4")
+    # 3-wide corpus: top-4 clips to all 3 columns, but the divisor
+    # stays 4 — asking for more than exists caps precision < 1
+    pred = np.array([[0.9, 0.8, 0.7]], np.float32)
+    label = np.array([[0, 1, 2]], np.float32)
+    np.testing.assert_allclose(m._calc(pred, label), [0.75])
+
+
+def test_prec_at_k_padding_and_empty_labels():
+    m = create_metric("prec@2")
+    pred = np.array([[0.9, 0.8, 0.1],
+                     [0.9, 0.8, 0.1]], np.float32)
+    label = np.array([[1, -1, -1], [-1, -1, -1]], np.float32)
+    np.testing.assert_allclose(m._calc(pred, label), [0.5, 0.0])
+
+
+def test_recall_prec_at_k_reject_bad_k():
+    with pytest.raises(ValueError):
+        create_metric("recall@0")
+    with pytest.raises(ValueError):
+        create_metric("prec@-1")
+
+
+def test_metricset_binds_recall_and_prec_at_k():
+    """The config path: metric[field] = recall@k / prec@k through
+    MetricSet (what eval_metric wiring calls), with the parity line
+    tags."""
+    ms = MetricSet()
+    ms.add_metric("recall@2", field="rel")
+    ms.add_metric("prec@2", field="rel")
+    pred = np.array([[0.9, 0.8, 0.1, 0.2]], np.float32)
+    rel = np.array([[1, 3]], np.float32)
+    ms.add_eval([pred, pred], {"rel": rel})
+    res = dict(ms.results())
+    assert res["recall@2[rel]"] == pytest.approx(0.5)
+    assert res["prec@2[rel]"] == pytest.approx(0.5)
+    s = ms.print_str("ev")
+    assert "\tev-recall@2[rel]:0.5" in s and "\tev-prec@2[rel]:0.5" in s

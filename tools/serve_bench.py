@@ -614,6 +614,212 @@ def run_continual_soak(args, monitor, sink):
     return rec, clean, serve_compiles == 0
 
 
+# -- retrieval scenario (--embed-search) ----------------------------------
+
+
+EMBED_NET = """
+netconfig=start
+layer[+1:h] = fullc:fc1
+  nhidden = 16
+  init_sigma = 0.1
+layer[+1] = relu
+layer[h->o] = fullc:fc2
+  nhidden = 8
+  init_sigma = 0.1
+layer[+0] = softmax
+netconfig=end
+input_shape = 1,1,64
+batch_size = 16
+eta = 0.1
+"""
+
+
+def run_embed_search(args, monitor, sink):
+    """``--embed-search``: the retrieval product closed-loop
+    (doc/retrieval.md). Builds a sealed indexed bundle via
+    ``task=build_index``, boots a fleet from it, and drives three
+    scenarios through the binary protocol's op-suffix grammar:
+    embed-only (``#embed``), search-only (``#search:k``), and the
+    fanned embed->search composition (``#fsearch:k``). Returns
+    (record, clean, zero_recompiles):
+
+    - ``clean`` is False (exit 3) on ANY failed request, an invalid
+      telemetry stream, or a recall spot-check that disagrees with
+      the NumPy oracle over the sealed index (exact search: served
+      top-k ids must match id-for-id);
+    - ``zero_recompiles`` is False (exit 1) on any post-warmup
+      compile — predict OR search program books, or a ``compile``
+      event anywhere in the stream.
+    """
+    import tempfile
+    import threading
+
+    from cxxnet_tpu.artifact import bundle as ab
+    from cxxnet_tpu.main import LearnTask
+    from cxxnet_tpu.monitor.schema import validate_records
+    from cxxnet_tpu.nnet.trainer import NetTrainer
+    from cxxnet_tpu.parallel import make_mesh
+    from cxxnet_tpu.retrieval import EmbeddingIndex, oracle_topk
+    from cxxnet_tpu.serve import BinaryClient, FleetServer
+    from cxxnet_tpu.utils.config import parse_config
+
+    sink.clear()
+    n_clients = max(int(t) for t in args.clients.split(",") if t)
+    with tempfile.TemporaryDirectory() as td:
+        pimg, plab = _write_soak_idx(td, n=120, d=8, name="ix")
+        model_dir = os.path.join(td, "models")
+        os.makedirs(model_dir)
+        conf = os.path.join(td, "run.conf")
+        with open(conf, "w") as f:
+            f.write('data = train\niter = mnist\n'
+                    '  path_img = "%s"\n  path_label = "%s"\n'
+                    '  silent = 1\niter = end\n%s\nmodel_dir = "%s"\n'
+                    'print_step = 0\n'
+                    % (pimg, plab, EMBED_NET, model_dir))
+        snap = os.path.join(model_dir, "0001.model.npz")
+        t = NetTrainer(parse_config(EMBED_NET), mesh=make_mesh(1, 1))
+        t.init_model()
+        t.save_model(snap)
+        rc = LearnTask().run([conf, "task=build_index",
+                              "model_in=%s" % snap,
+                              "index_metric=cosine", "index_rows=96",
+                              "search_k=8", "search_buckets=1,4,16"])
+        assert rc == 0, "task=build_index failed"
+        bundle = ab.default_bundle_path(snap)
+        idx = EmbeddingIndex.deserialize(ab.read_index_member(bundle))
+        k = int(ab.bundle_manifest(bundle)["index"]["k"])
+        sink.clear()        # the bench stream starts at the boot
+
+        fleet = FleetServer(parse_config(EMBED_NET) + [
+            ("serve_models", "bench=%s" % bundle),
+            ("serve_http_port", "-1"),
+            ("serve_binary_port", "0"),
+            ("serve_max_delay_ms", str(args.max_delay_ms)),
+            ("silent", "1"),
+        ], monitor=monitor)
+        fleet.start()
+        try:
+            rng = np.random.RandomState(0)
+            pool = rng.rand(64, 64).astype(np.float32)
+            # one embed pass seeds the search-only query pool and the
+            # oracle spot-check (post-warmup: already zero-compile)
+            bc = BinaryClient("127.0.0.1", fleet.binary_port)
+            parts = []
+            for i in range(0, len(pool), 16):   # <= max_batch rows
+                st, part = bc.predict(pool[i:i + 16],
+                                      model="bench#embed",
+                                      tenant="bench")
+                assert st == "ok", part
+                parts.append(np.asarray(part, np.float32))
+            bc.close()
+            qpool = np.concatenate(parts, axis=0)
+
+            def drive(model, rows_pool):
+                lats = []
+                counts = {"ok": 0, "failed": 0}
+                lock = threading.Lock()
+                span = max(1, len(rows_pool) - args.request_rows + 1)
+
+                def client(ci):
+                    c = BinaryClient("127.0.0.1", fleet.binary_port,
+                                     timeout=120)
+                    r = np.random.RandomState(ci)
+                    try:
+                        for _ in range(args.requests):
+                            i = r.randint(0, span)
+                            rows = rows_pool[i:i + args.request_rows]
+                            t0 = time.monotonic()
+                            st, _ = c.predict(rows, model=model,
+                                              tenant="bench")
+                            dt = (time.monotonic() - t0) * 1e3
+                            with lock:
+                                lats.append(dt)
+                                counts["ok" if st == "ok"
+                                       else "failed"] += 1
+                    finally:
+                        c.close()
+
+                threads = [threading.Thread(target=client, args=(i,))
+                           for i in range(n_clients)]
+                wall0 = time.monotonic()
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                wall = time.monotonic() - wall0
+                lats.sort()
+
+                def pct(q):
+                    return lats[min(len(lats) - 1,
+                                    int(q * len(lats)))] \
+                        if lats else 0.0
+
+                return {
+                    "model": model, "clients": n_clients,
+                    "ok": counts["ok"], "failed": counts["failed"],
+                    "rows_per_sec": round(
+                        counts["ok"] * args.request_rows
+                        / max(wall, 1e-9), 1),
+                    "latency_p50_ms": round(pct(0.50), 3),
+                    "latency_p99_ms": round(pct(0.99), 3),
+                }
+
+            points = []
+            for name, model, rows_pool in (
+                    ("embed_only", "bench#embed", pool),
+                    ("search_only", "bench#search:%d" % k, qpool),
+                    ("fanned_mix", "bench#fsearch:%d" % k, pool)):
+                pt = drive(model, rows_pool)
+                pt["scenario"] = name
+                points.append(pt)
+                print("# %s: %.1f rows/s, p50 %.2f ms, p99 %.2f ms, "
+                      "%d ok / %d failed"
+                      % (name, pt["rows_per_sec"],
+                         pt["latency_p50_ms"], pt["latency_p99_ms"],
+                         pt["ok"], pt["failed"]), file=sys.stderr)
+
+            # recall spot-check: served ids vs the NumPy oracle over
+            # the sealed index — exact search, so anything below 1.0
+            # is a wrong answer, not an approximation
+            bc = BinaryClient("127.0.0.1", fleet.binary_port)
+            st, out = bc.predict(qpool[:16],
+                                 model="bench#search:%d" % k,
+                                 tenant="bench")
+            bc.close()
+            assert st == "ok", out
+            got = np.asarray(out)[:, :k].astype(np.int64)
+            oids, _ = oracle_topk(idx, qpool[:16], k)
+            recall = float((got == oids).mean())
+
+            health = fleet.health_snapshot()["model_health"][0]
+            compiles = health["compile_events"] \
+                + health.get("search_compile_events", 0) \
+                + len([r for r in sink.records
+                       if r.get("event") == "compile"])
+            errs = validate_records(list(sink.records))
+        finally:
+            fleet.close()
+    clean = all(p["failed"] == 0 for p in points) \
+        and recall >= 0.999 and not errs
+    rec = {
+        "name": "serve_bench", "scenario": "embed_search",
+        "t": time.time(),
+        "requests_per_client": args.requests,
+        "request_rows": args.request_rows,
+        "index_rows": idx.rows, "dim": idx.dim,
+        "metric": idx.metric, "k": k,
+        "recall_at_k": round(recall, 4),
+        "scenarios": points,
+        "failed": sum(p["failed"] for p in points),
+        "schema_errors": len(errs),
+        "zero_recompiles": compiles == 0,
+    }
+    print("# embed-search: recall@%d %.3f vs oracle, %d failed, "
+          "compiles %d" % (k, recall, rec["failed"], compiles),
+          file=sys.stderr)
+    return rec, clean, compiles == 0
+
+
 # -- multi-replica fleet scenario (--replicas) ----------------------------
 
 
@@ -1974,6 +2180,18 @@ def main(argv=None) -> int:
                          "0 gives the legacy per-dispatch "
                          "fold/quantize baseline for before/after "
                          "records")
+    ap.add_argument("--embed-search", action="store_true",
+                    help="retrieval product scenario "
+                         "(doc/retrieval.md): build an indexed "
+                         "bundle via task=build_index, boot a fleet "
+                         "from it, and drive embed-only / "
+                         "search-only / fanned embed->search "
+                         "closed loops through the binary op-suffix "
+                         "grammar; the record carries rows/s + "
+                         "p50/p99 per scenario and a recall "
+                         "spot-check vs the NumPy oracle (exit 1 on "
+                         "post-warmup compiles, 3 on failed "
+                         "requests or a recall miss)")
     ap.add_argument("--peak-tflops", type=float, default=0.0,
                     help="chip peak TFLOP/s for the serve dtype; when "
                          "set, every sweep point carries an MFU column "
@@ -2007,6 +2225,13 @@ def main(argv=None) -> int:
         ap.error("--balancers is its own scenario (front tier over "
                  "null replicas); drop "
                  "--replicas/--tenants/--generations/--artifact")
+    if args.embed_search and (args.replicas or args.tenants
+                              or args.generations or args.balancers
+                              or args.artifact):
+        ap.error("--embed-search is its own scenario (it builds and "
+                 "seals its own indexed bundle); drop "
+                 "--replicas/--tenants/--generations/--balancers/"
+                 "--artifact")
 
     from cxxnet_tpu.monitor import MemorySink, Monitor
     import jax
@@ -2023,6 +2248,20 @@ def main(argv=None) -> int:
         # exit-code convention (bench.py): 3 = a request failed, a
         # door kill dropped traffic, or the quota bound was breached;
         # no engines run so recompiles cannot occur
+        return 0 if clean else 3
+    if args.embed_search:
+        rec, clean, zero_recompiles = run_embed_search(
+            args, monitor, sink)
+        rec["platform"] = jax.default_backend()
+        out = json.dumps(rec, sort_keys=True)
+        print(out)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(out + "\n")
+        # exit-code convention: 1 = post-warmup compiles, 3 = a
+        # request failed or the recall spot-check missed the oracle
+        if not zero_recompiles:
+            return 1
         return 0 if clean else 3
     if args.generations:
         rec, clean, zero_recompiles = run_continual_soak(
